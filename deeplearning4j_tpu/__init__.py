@@ -1,0 +1,26 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A from-scratch JAX/XLA/Pallas re-design with capability parity to the 2015
+Skymind Deeplearning4j stack (reference: huamichaelchen/deeplearning4j).
+Where the reference delegated tensor math to ND4J (JBLAS/JCublas) and wrote
+hand-coded backprop per layer, this framework is built TPU-first:
+
+- ops/        named activation/loss/init/updater registries, jit-compiled
+              (replaces the ND4J op surface, ref SURVEY §1 L0)
+- nn/         typed configs with JSON/YAML round-trip + pure init/apply layers
+              (replaces nn/conf + nn/layers, ref deeplearning4j-core)
+- models/     MultiLayerNetwork and friends (ref nn/multilayer)
+- optimize/   solvers (SGD/line-search/CG/LBFGS), listeners (ref optimize/)
+- datasets/   DataSet + iterators/fetchers (ref datasets/ + Canova bridge)
+- eval/       Evaluation + ConfusionMatrix (ref eval/)
+- parallel/   SPMD data/model parallelism over jax.sharding.Mesh + psum
+              (replaces Spark/Akka/YARN parameter averaging, ref scaleout)
+- nlp/        Word2Vec/GloVe/ParagraphVectors, tokenizers (ref dl4j-nlp)
+- clustering/ KMeans + spatial trees (ref clustering/)
+- plot/       t-SNE (ref plot/)
+- runtime/    control plane: job queue, heartbeats, checkpointing
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.ops import activations, losses, initializers, updaters  # noqa: F401

@@ -1,0 +1,19 @@
+"""Core op registries: the TPU-native replacement for the ND4J op surface.
+
+The reference looked up elementwise transforms *by string name* and asked each
+for a `.derivative()` twin (MultiLayerNetwork.java:584-597). Under JAX the
+derivative comes from autodiff, so the registries here only map names to pure
+functions; `jax.grad` supplies every derivative.
+"""
+
+from deeplearning4j_tpu.ops.activations import get_activation, register_activation
+from deeplearning4j_tpu.ops.losses import get_loss, register_loss
+from deeplearning4j_tpu.ops.initializers import init_weights, WeightInit
+from deeplearning4j_tpu.ops.updaters import make_updater, Updater
+
+__all__ = [
+    "get_activation", "register_activation",
+    "get_loss", "register_loss",
+    "init_weights", "WeightInit",
+    "make_updater", "Updater",
+]

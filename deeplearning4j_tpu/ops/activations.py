@@ -1,0 +1,88 @@
+"""Named activation registry.
+
+Parity target: the string-named transform ops the reference resolves through
+ND4J's OpFactory (`Nd4j.getOpFactory().createTransform(name, x)`, used at
+reference MultiLayerNetwork.java:584-597 and BaseLayer.java:347-357). The
+reference needed explicit `.derivative()` ops because it had no autodiff; here
+every activation is a pure jnp function and JAX derives gradients.
+
+All functions are jit-safe, dtype-preserving, and vectorize over any shape.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+ActivationFn = Callable[[jax.Array], jax.Array]
+
+_ACTIVATIONS: Dict[str, ActivationFn] = {}
+
+
+def register_activation(name: str, fn: ActivationFn) -> None:
+    """Register an activation under a string name (case-insensitive)."""
+    _ACTIVATIONS[name.lower()] = fn
+
+
+def get_activation(name: str) -> ActivationFn:
+    """Resolve an activation by name; raises KeyError with known names listed."""
+    key = name.lower()
+    if key not in _ACTIVATIONS:
+        raise KeyError(
+            f"Unknown activation '{name}'. Known: {sorted(_ACTIVATIONS)}"
+        )
+    return _ACTIVATIONS[key]
+
+
+def available_activations() -> list[str]:
+    return sorted(_ACTIVATIONS)
+
+
+def _softmax(x: jax.Array) -> jax.Array:
+    # Row-wise softmax over the last axis, numerically stabilised — the
+    # reference's "softmax" transform operates row-wise on [batch, nOut].
+    return jax.nn.softmax(x, axis=-1)
+
+
+def _hardtanh(x: jax.Array) -> jax.Array:
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _leakyrelu(x: jax.Array) -> jax.Array:
+    return jax.nn.leaky_relu(x, negative_slope=0.01)
+
+
+# The registry covers every activation name the reference accepts in
+# NeuralNetConfiguration (activationFunction, reference
+# NeuralNetConfiguration.java:116) plus modern conveniences.
+register_activation("sigmoid", jax.nn.sigmoid)
+register_activation("tanh", jnp.tanh)
+register_activation("relu", jax.nn.relu)
+register_activation("leakyrelu", _leakyrelu)
+register_activation("softmax", _softmax)
+register_activation("linear", lambda x: x)
+register_activation("identity", lambda x: x)
+register_activation("softplus", jax.nn.softplus)
+register_activation("softsign", jax.nn.soft_sign)
+register_activation("hardtanh", _hardtanh)
+register_activation("hardsigmoid", jax.nn.hard_sigmoid)
+register_activation("elu", jax.nn.elu)
+register_activation("selu", jax.nn.selu)
+register_activation("gelu", jax.nn.gelu)
+register_activation("swish", jax.nn.silu)
+register_activation("silu", jax.nn.silu)
+register_activation("exp", jnp.exp)
+register_activation("abs", jnp.abs)
+register_activation("sqrt", jnp.sqrt)
+register_activation("sign", jnp.sign)
+register_activation("cos", jnp.cos)
+register_activation("sin", jnp.sin)
+register_activation("log", jnp.log)
+register_activation("pow2", lambda x: jnp.square(x))
+register_activation("round", jnp.round)
+register_activation("floor", jnp.floor)
+register_activation("ceil", jnp.ceil)
+register_activation("negative", jnp.negative)
+register_activation("sqr", jnp.square)

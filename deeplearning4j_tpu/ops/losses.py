@@ -1,0 +1,138 @@
+"""Named loss registry.
+
+Parity target: ND4J's `LossFunctions.LossFunction` enum consumed by the
+reference at nn/layers/BaseLayer.java:186-193 and
+NeuralNetConfiguration.java:95 — MSE, EXPLL, XENT, MCXENT, RMSE_XENT,
+SQUARED_LOSS, RECONSTRUCTION_CROSSENTROPY, NEGATIVELOGLIKELIHOOD, plus a
+CUSTOM hook.
+
+Every loss has signature ``loss(labels, predictions) -> scalar`` (mean over
+the batch), is jit-safe and differentiable. Losses operate on *activated*
+outputs (post-softmax/sigmoid), matching the reference's LossCalculation which
+scored activated output; for fused logit variants see ``*_with_logits`` names.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+LossFn = Callable[[jax.Array, jax.Array], jax.Array]
+
+_LOSSES: Dict[str, LossFn] = {}
+
+_EPS = 1e-7
+
+
+def register_loss(name: str, fn: LossFn) -> None:
+    _LOSSES[name.lower()] = fn
+
+
+def get_loss(name: str) -> LossFn:
+    key = name.lower()
+    if key not in _LOSSES:
+        raise KeyError(f"Unknown loss '{name}'. Known: {sorted(_LOSSES)}")
+    return _LOSSES[key]
+
+
+def available_losses() -> list[str]:
+    return sorted(_LOSSES)
+
+
+def _clip(p: jax.Array) -> jax.Array:
+    return jnp.clip(p, _EPS, 1.0 - _EPS)
+
+
+def mse(labels: jax.Array, preds: jax.Array) -> jax.Array:
+    """Mean squared error, averaged over batch and summed over features."""
+    return jnp.mean(jnp.sum(jnp.square(labels - preds), axis=-1))
+
+
+def rmse(labels: jax.Array, preds: jax.Array) -> jax.Array:
+    return jnp.sqrt(mse(labels, preds) + _EPS)
+
+
+def squared_loss(labels: jax.Array, preds: jax.Array) -> jax.Array:
+    """Total squared error (reference SQUARED_LOSS — unaveraged over features)."""
+    return jnp.mean(jnp.sum(jnp.square(labels - preds), axis=-1))
+
+
+def xent(labels: jax.Array, preds: jax.Array) -> jax.Array:
+    """Binary cross-entropy on sigmoid outputs (reference XENT)."""
+    p = _clip(preds)
+    per = labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p)
+    return -jnp.mean(jnp.sum(per, axis=-1))
+
+
+def mcxent(labels: jax.Array, preds: jax.Array) -> jax.Array:
+    """Multi-class cross-entropy on softmax outputs (reference MCXENT)."""
+    p = _clip(preds)
+    return -jnp.mean(jnp.sum(labels * jnp.log(p), axis=-1))
+
+
+def negative_log_likelihood(labels: jax.Array, preds: jax.Array) -> jax.Array:
+    """Reference NEGATIVELOGLIKELIHOOD — same functional form as MCXENT."""
+    return mcxent(labels, preds)
+
+
+def rmse_xent(labels: jax.Array, preds: jax.Array) -> jax.Array:
+    """Reference RMSE_XENT: sqrt of squared error (legacy hybrid)."""
+    return jnp.mean(jnp.sum(jnp.sqrt(jnp.square(labels - preds) + _EPS), axis=-1))
+
+
+def expll(labels: jax.Array, preds: jax.Array) -> jax.Array:
+    """Exponential log-likelihood (Poisson-style, reference EXPLL)."""
+    p = _clip(preds)
+    return jnp.mean(jnp.sum(p - labels * jnp.log(p), axis=-1))
+
+
+def reconstruction_crossentropy(labels: jax.Array, preds: jax.Array) -> jax.Array:
+    """Reference RECONSTRUCTION_CROSSENTROPY (autoencoder/RBM scoring)."""
+    return xent(labels, preds)
+
+
+def mcxent_with_logits(labels: jax.Array, logits: jax.Array) -> jax.Array:
+    """Fused softmax+CE on raw logits — numerically preferred on TPU."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+
+def xent_with_logits(labels: jax.Array, logits: jax.Array) -> jax.Array:
+    """Fused sigmoid+BCE on raw logits."""
+    # log(1+e^z) formulated stably.
+    per = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return jnp.mean(jnp.sum(per, axis=-1))
+
+
+def cosine_proximity(labels: jax.Array, preds: jax.Array) -> jax.Array:
+    ln = jnp.linalg.norm(labels, axis=-1) + _EPS
+    pn = jnp.linalg.norm(preds, axis=-1) + _EPS
+    return -jnp.mean(jnp.sum(labels * preds, axis=-1) / (ln * pn))
+
+
+def hinge(labels: jax.Array, preds: jax.Array) -> jax.Array:
+    """Hinge loss; labels in {0,1} one-hot → mapped to ±1."""
+    signed = 2.0 * labels - 1.0
+    return jnp.mean(jnp.sum(jnp.maximum(0.0, 1.0 - signed * preds), axis=-1))
+
+
+def mae(labels: jax.Array, preds: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.sum(jnp.abs(labels - preds), axis=-1))
+
+
+register_loss("mse", mse)
+register_loss("rmse", rmse)
+register_loss("squared_loss", squared_loss)
+register_loss("xent", xent)
+register_loss("mcxent", mcxent)
+register_loss("negativeloglikelihood", negative_log_likelihood)
+register_loss("rmse_xent", rmse_xent)
+register_loss("expll", expll)
+register_loss("reconstruction_crossentropy", reconstruction_crossentropy)
+register_loss("mcxent_with_logits", mcxent_with_logits)
+register_loss("xent_with_logits", xent_with_logits)
+register_loss("cosine_proximity", cosine_proximity)
+register_loss("hinge", hinge)
+register_loss("mae", mae)

@@ -1,0 +1,237 @@
+"""Gradient updaters (optimisers) as pure pytree transforms.
+
+Parity target: reference `nn/conf/Updater.java:9` enum (SGD, ADAM, ADADELTA,
+NESTEROVS, ADAGRAD, RMSPROP, CUSTOM) realised via per-parameter
+`org.nd4j.linalg.learning.GradientUpdater` wrappers (`nn/updater/*.java`), plus
+the shared post-apply semantics of `BaseUpdater.postApply()`
+(reference nn/updater/BaseUpdater.java:44-58): L1/L2 regularisation folded into
+the gradient, minibatch-size division, and gradient normalisation/clipping.
+
+Design: optax-style stateless transforms — ``init(params) -> state`` and
+``update(grads, state, params) -> (updates, state)`` — where *updates* is the
+step to ADD to params (already scaled by -lr). The whole thing lives inside
+the jitted train step; state is a pytree that shards with the params, so the
+same updater works untouched under pjit/shard_map data- or model-parallelism.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+class Updater(str, enum.Enum):
+    SGD = "sgd"
+    ADAM = "adam"
+    ADAMW = "adamw"
+    ADADELTA = "adadelta"
+    NESTEROVS = "nesterovs"
+    ADAGRAD = "adagrad"
+    RMSPROP = "rmsprop"
+    LION = "lion"  # TPU-era addition beyond the reference enum
+    NONE = "none"
+
+
+class UpdaterTransform(NamedTuple):
+    init: Callable[[PyTree], PyTree]
+    update: Callable[[PyTree, PyTree, Optional[PyTree]], Tuple[PyTree, PyTree]]
+
+
+@dataclass(frozen=True)
+class UpdaterConfig:
+    """Hyperparameters shared across the updater family; mirrors the flat bag
+    in reference NeuralNetConfiguration.java:71-95 (lr, momentum, rho, epsilon,
+    l1/l2, gradient normalisation)."""
+
+    updater: Updater | str = Updater.SGD
+    learning_rate: float = 1e-1
+    momentum: float = 0.9           # NESTEROVS
+    rho: float = 0.95               # ADADELTA / RMSPROP decay
+    epsilon: float = 1e-6
+    beta1: float = 0.9              # ADAM
+    beta2: float = 0.999
+    weight_decay: float = 0.0       # ADAMW decoupled decay
+    l1: float = 0.0
+    l2: float = 0.0
+    clip_norm: Optional[float] = None      # global-norm clip
+    clip_value: Optional[float] = None     # elementwise clip
+    unit_norm: bool = False                # per-leaf unit-norm (ref GradientNormalization)
+    lr_schedule: Optional[Callable[[jax.Array], jax.Array]] = field(
+        default=None, compare=False
+    )
+
+
+def _zeros_like_tree(params: PyTree) -> PyTree:
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def _global_norm(tree: PyTree) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l)) for l in leaves))
+
+
+def pre_apply(grads: PyTree, params: PyTree, cfg: UpdaterConfig) -> PyTree:
+    """Fold L1/L2 penalties and clipping into the raw gradient — the TPU-native
+    equivalent of reference BaseUpdater.postApply():44-58 (which mutated the
+    gradient before the learning-rate step). Pure function of its inputs."""
+    if cfg.l2:
+        grads = jax.tree_util.tree_map(lambda g, p: g + cfg.l2 * p, grads, params)
+    if cfg.l1:
+        grads = jax.tree_util.tree_map(
+            lambda g, p: g + cfg.l1 * jnp.sign(p), grads, params
+        )
+    if cfg.clip_value is not None:
+        grads = jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -cfg.clip_value, cfg.clip_value), grads
+        )
+    if cfg.clip_norm is not None:
+        gnorm = _global_norm(grads)
+        scale = jnp.minimum(1.0, cfg.clip_norm / (gnorm + 1e-12))
+        grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+    if cfg.unit_norm:
+        grads = jax.tree_util.tree_map(
+            lambda g: g / (jnp.linalg.norm(g.reshape(-1)) + 1e-12), grads
+        )
+    return grads
+
+
+def _lr_at(cfg: UpdaterConfig, step: jax.Array) -> jax.Array:
+    if cfg.lr_schedule is not None:
+        return cfg.lr_schedule(step)
+    return jnp.asarray(cfg.learning_rate, jnp.float32)
+
+
+def make_updater(cfg: UpdaterConfig) -> UpdaterTransform:
+    """Build the named updater transform. All returned callables are jit-safe.
+
+    State layout: {"step": scalar, **per-updater accumulators} so checkpointing
+    the optimizer state (absent in the reference — SURVEY §5) is a plain pytree
+    save.
+    """
+    kind = Updater(cfg.updater)
+
+    def init(params: PyTree) -> PyTree:
+        state = {"step": jnp.zeros((), jnp.int32)}
+        if kind in (Updater.ADAM, Updater.ADAMW):
+            state["m"] = _zeros_like_tree(params)
+            state["v"] = _zeros_like_tree(params)
+        elif kind == Updater.NESTEROVS:
+            state["mom"] = _zeros_like_tree(params)
+        elif kind == Updater.ADAGRAD:
+            state["acc"] = _zeros_like_tree(params)
+        elif kind == Updater.RMSPROP:
+            state["ms"] = _zeros_like_tree(params)
+        elif kind == Updater.ADADELTA:
+            state["acc_g"] = _zeros_like_tree(params)
+            state["acc_dx"] = _zeros_like_tree(params)
+        elif kind == Updater.LION:
+            state["m"] = _zeros_like_tree(params)
+        return state
+
+    def update(grads: PyTree, state: PyTree, params: Optional[PyTree] = None):
+        grads = pre_apply(grads, params, cfg) if params is not None else grads
+        step = state["step"] + 1
+        lr = _lr_at(cfg, step)
+        new_state = {"step": step}
+
+        if kind in (Updater.SGD, Updater.NONE):
+            updates = jax.tree_util.tree_map(lambda g: -lr * g, grads)
+
+        elif kind == Updater.NESTEROVS:
+            # Nesterov momentum in the "lookahead applied to update" form used
+            # by ND4J's Nesterovs updater: v <- mu*v - lr*g; step = mu*v - lr*g
+            mu = cfg.momentum
+            mom = jax.tree_util.tree_map(
+                lambda v, g: mu * v - lr * g, state["mom"], grads
+            )
+            updates = jax.tree_util.tree_map(
+                lambda v, g: mu * v - lr * g, mom, grads
+            )
+            new_state["mom"] = mom
+
+        elif kind == Updater.ADAGRAD:
+            acc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.square(g), state["acc"], grads
+            )
+            updates = jax.tree_util.tree_map(
+                lambda a, g: -lr * g / (jnp.sqrt(a) + cfg.epsilon), acc, grads
+            )
+            new_state["acc"] = acc
+
+        elif kind == Updater.RMSPROP:
+            ms = jax.tree_util.tree_map(
+                lambda s, g: cfg.rho * s + (1 - cfg.rho) * jnp.square(g),
+                state["ms"], grads,
+            )
+            updates = jax.tree_util.tree_map(
+                lambda s, g: -lr * g / (jnp.sqrt(s) + cfg.epsilon), ms, grads
+            )
+            new_state["ms"] = ms
+
+        elif kind == Updater.ADADELTA:
+            rho, eps = cfg.rho, cfg.epsilon
+            acc_g = jax.tree_util.tree_map(
+                lambda a, g: rho * a + (1 - rho) * jnp.square(g),
+                state["acc_g"], grads,
+            )
+            dx = jax.tree_util.tree_map(
+                lambda ag, adx, g: -jnp.sqrt(adx + eps) / jnp.sqrt(ag + eps) * g,
+                acc_g, state["acc_dx"], grads,
+            )
+            acc_dx = jax.tree_util.tree_map(
+                lambda a, d: rho * a + (1 - rho) * jnp.square(d),
+                state["acc_dx"], dx,
+            )
+            updates = dx
+            new_state["acc_g"] = acc_g
+            new_state["acc_dx"] = acc_dx
+
+        elif kind in (Updater.ADAM, Updater.ADAMW):
+            b1, b2, eps = cfg.beta1, cfg.beta2, cfg.epsilon
+            m = jax.tree_util.tree_map(
+                lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads
+            )
+            v = jax.tree_util.tree_map(
+                lambda v_, g: b2 * v_ + (1 - b2) * jnp.square(g), state["v"], grads
+            )
+            t = step.astype(jnp.float32)
+            mhat_scale = 1.0 / (1.0 - b1 ** t)
+            vhat_scale = 1.0 / (1.0 - b2 ** t)
+            updates = jax.tree_util.tree_map(
+                lambda m_, v_: -lr * (m_ * mhat_scale)
+                / (jnp.sqrt(v_ * vhat_scale) + eps),
+                m, v,
+            )
+            if kind == Updater.ADAMW and cfg.weight_decay and params is not None:
+                updates = jax.tree_util.tree_map(
+                    lambda u, p: u - lr * cfg.weight_decay * p, updates, params
+                )
+            new_state["m"] = m
+            new_state["v"] = v
+
+        elif kind == Updater.LION:
+            b1, b2 = cfg.beta1, cfg.beta2
+            updates = jax.tree_util.tree_map(
+                lambda m_, g: -lr * jnp.sign(b1 * m_ + (1 - b1) * g),
+                state["m"], grads,
+            )
+            new_state["m"] = jax.tree_util.tree_map(
+                lambda m_, g: b2 * m_ + (1 - b2) * g, state["m"], grads
+            )
+
+        else:
+            raise ValueError(f"Unhandled updater: {kind}")
+
+        return updates, new_state
+
+    return UpdaterTransform(init=init, update=update)
+
+
+def apply_updates(params: PyTree, updates: PyTree) -> PyTree:
+    return jax.tree_util.tree_map(lambda p, u: p + u, params, updates)

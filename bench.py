@@ -1,0 +1,78 @@
+"""Benchmark harness: LeNet-5 MNIST training throughput (BASELINE.md config #1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md), so `vs_baseline` compares
+against the first recorded run of THIS harness (stored in
+`.bench_baseline.json` at the repo root on first execution): round 1 pins the
+baseline at 1.0 and later rounds show the speedup factor.
+
+Procedure per BASELINE.md: warm up (compile excluded), time >=100 steps,
+report median-window examples/sec/chip.
+"""
+
+import json
+import os
+import pathlib
+import time
+
+import numpy as np
+
+BATCH = 256
+WARMUP = 5
+STEPS = 100
+
+
+def build():
+    import jax
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from __graft_entry__ import _lenet_conf
+
+    net = MultiLayerNetwork(_lenet_conf("sgd")).init()
+    rng = np.random.default_rng(0)
+    x = rng.random((BATCH, 28, 28, 1), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)]
+    return net, jax.numpy.asarray(x), jax.numpy.asarray(y)
+
+
+def main() -> None:
+    import jax
+
+    net, x, y = build()
+    for _ in range(WARMUP):
+        net.fit_batch(x, y)
+    jax.block_until_ready(net.params)
+
+    times = []
+    chunk = 10
+    for _ in range(STEPS // chunk):
+        t0 = time.perf_counter()
+        for _ in range(chunk):
+            net.fit_batch(x, y)
+        jax.block_until_ready(net.params)
+        times.append((time.perf_counter() - t0) / chunk)
+    sec_per_step = float(np.median(times))
+    examples_per_sec = BATCH / sec_per_step
+
+    baseline_path = pathlib.Path(__file__).parent / ".bench_baseline.json"
+    if baseline_path.exists():
+        baseline = json.loads(baseline_path.read_text())["value"]
+    else:
+        baseline = examples_per_sec
+        baseline_path.write_text(json.dumps({
+            "metric": "LeNet-MNIST train examples/sec/chip",
+            "value": examples_per_sec,
+            "recorded": time.strftime("%Y-%m-%d"),
+        }))
+
+    print(json.dumps({
+        "metric": "LeNet-MNIST train examples/sec/chip",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/sec",
+        "vs_baseline": round(examples_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    ArrayDataSetIterator,
+    DataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+)
+
+__all__ = [
+    "DataSet", "DataSetIterator", "ArrayDataSetIterator",
+    "MultipleEpochsIterator", "SamplingDataSetIterator",
+]

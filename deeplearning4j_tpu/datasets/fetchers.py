@@ -1,0 +1,160 @@
+"""Dataset fetchers.
+
+Parity: reference `datasets/fetchers/*` (MNIST `MnistDataFetcher.java:39`,
+Iris `IrisDataFetcher`, Curves, LFW, CSV) and the Canova record-reader bridge
+(`RecordReaderDataSetIterator`). This environment has no network egress, so:
+
+- Iris comes from sklearn's bundled copy (same 150-example dataset the
+  reference ships in dl4j-test-resources).
+- `mnist_dataset()` loads a real MNIST IDX directory if one is present
+  (MNIST_DIR env var), else falls back to sklearn's 8x8 digits upscaled to
+  28x28, else synthetic — callers get MNIST-shaped data either way.
+- CSV / SVMLight readers replace the Canova record-reader path used by the
+  CLI (reference Train.java:155-165, default SVMLightInputFormat).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+def one_hot(indices: np.ndarray, num_classes: int) -> np.ndarray:
+    out = np.zeros((len(indices), num_classes), np.float32)
+    out[np.arange(len(indices)), indices.astype(int)] = 1.0
+    return out
+
+
+def iris_dataset(normalize: bool = True) -> DataSet:
+    """The 150-example Iris set (reference IrisDataFetcher / iris.dat)."""
+    from sklearn.datasets import load_iris
+
+    data = load_iris()
+    x = data.data.astype(np.float32)
+    y = one_hot(data.target, 3)
+    ds = DataSet(x, y)
+    return ds.normalize_zero_mean_unit_variance() if normalize else ds
+
+
+def _read_idx(path: Path) -> np.ndarray:
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(shape)
+
+
+def mnist_dataset(split: str = "train", binarize: bool = False,
+                  flatten: bool = False) -> DataSet:
+    """Real MNIST if MNIST_DIR points at IDX files (reference MnistDataFetcher
+    + MnistManager IDX parsing); else digits-upscaled; else synthetic.
+    Features in [0,1], shape [N,28,28,1] (NHWC) or flat [N,784]."""
+    mnist_dir = os.environ.get("MNIST_DIR")
+    if mnist_dir:
+        d = Path(mnist_dir)
+        prefix = "train" if split == "train" else "t10k"
+        for img_name in (f"{prefix}-images-idx3-ubyte", f"{prefix}-images.idx3-ubyte"):
+            for suffix in ("", ".gz"):
+                p = d / (img_name + suffix)
+                if p.exists():
+                    images = _read_idx(p).astype(np.float32) / 255.0
+                    lbl = img_name.replace("images-idx3", "labels-idx1").replace(
+                        "images.idx3", "labels.idx1")
+                    labels = _read_idx(d / (lbl + suffix))
+                    return _package_mnist(images, labels, binarize, flatten)
+    try:
+        return _digits_as_mnist(split, binarize, flatten)
+    except Exception:
+        return synthetic_mnist(6000 if split == "train" else 1000,
+                               binarize=binarize, flatten=flatten)
+
+
+def _digits_as_mnist(split: str, binarize: bool, flatten: bool) -> DataSet:
+    from sklearn.datasets import load_digits
+
+    digits = load_digits()
+    x8 = digits.images.astype(np.float32) / 16.0  # [N, 8, 8]
+    x28 = np.kron(x8, np.ones((1, 4, 4), np.float32))[:, 2:-2, 2:-2]  # crude 28x28...
+    # np.kron gives 32x32; crop to 28x28 center.
+    n = len(x28)
+    cut = int(n * 0.8)
+    if split == "train":
+        images, labels = x28[:cut], digits.target[:cut]
+    else:
+        images, labels = x28[cut:], digits.target[cut:]
+    return _package_mnist(images, labels, binarize, flatten)
+
+
+def synthetic_mnist(n: int = 6000, binarize: bool = False,
+                    flatten: bool = False, seed: int = 0) -> DataSet:
+    """Class-dependent Gaussian blobs at MNIST shapes — enough for throughput
+    benchmarks and smoke tests when no real data exists."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, n)
+    centers = rng.random((10, 28, 28)).astype(np.float32)
+    images = centers[labels] * 0.5 + rng.random((n, 28, 28)).astype(np.float32) * 0.5
+    return _package_mnist(images, labels, binarize, flatten)
+
+
+def _package_mnist(images: np.ndarray, labels: np.ndarray, binarize: bool,
+                   flatten: bool) -> DataSet:
+    if binarize:
+        images = (images > 0.5).astype(np.float32)  # ref binarize threshold 30/255
+    x = images.reshape(len(images), -1) if flatten else images[..., None]
+    return DataSet(x.astype(np.float32), one_hot(labels, 10))
+
+
+def csv_dataset(path: str, label_col: int = -1, num_classes: Optional[int] = None,
+                skip_header: bool = False, delimiter: str = ",") -> DataSet:
+    """CSV → DataSet (reference CSVDataSetIterator / Canova CSV reader)."""
+    raw = np.genfromtxt(path, delimiter=delimiter,
+                        skip_header=1 if skip_header else 0, dtype=np.float32)
+    if raw.ndim == 1:
+        raw = raw[None, :]
+    labels = raw[:, label_col].astype(int)
+    features = np.delete(raw, label_col if label_col >= 0 else raw.shape[1] + label_col,
+                         axis=1)
+    k = num_classes or int(labels.max()) + 1
+    return DataSet(features.astype(np.float32), one_hot(labels, k))
+
+
+def svmlight_dataset(path: str, num_features: int,
+                     num_classes: Optional[int] = None) -> DataSet:
+    """SVMLight/libsvm format (reference CLI default input format,
+    Train.java:74)."""
+    rows, labels = [], []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            vec = np.zeros(num_features, np.float32)
+            for tok in parts[1:]:
+                i, v = tok.split(":")
+                vec[int(i) - 1] = float(v)  # svmlight is 1-indexed
+            rows.append(vec)
+    y = np.asarray(labels)
+    y_int = y.astype(int)
+    if np.all(y == y_int):
+        # Map raw label values (e.g. the format's conventional ±1) to
+        # contiguous class indices.
+        classes = np.unique(y_int)
+        if num_classes is not None and classes.min() >= 0:
+            k = num_classes
+            idx = y_int
+        else:
+            k = len(classes)
+            remap = {c: i for i, c in enumerate(classes.tolist())}
+            idx = np.array([remap[c] for c in y_int])
+        return DataSet(np.stack(rows), one_hot(idx, k))
+    return DataSet(np.stack(rows), y[:, None].astype(np.float32))

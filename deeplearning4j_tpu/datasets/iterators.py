@@ -1,0 +1,118 @@
+"""DataSet iterators.
+
+Parity: reference `datasets/iterator/DataSetIterator.java:53` +
+`BaseDatasetIterator`, and the wrapper iterators (`MultipleEpochsIterator`,
+`SamplingDataSetIterator`). Iterators yield fixed-shape batches — static
+shapes keep XLA from recompiling per step. A short final batch is padded to
+the batch size by wrapping around to the epoch's first examples (so those
+examples carry slightly more weight that epoch), or dropped with
+``drop_last=True``."""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Base protocol: python-iterable of DataSet batches + reset()."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def reset(self) -> None:  # re-shuffle / rewind; default no-op
+        pass
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+    def total_examples(self) -> int:
+        raise NotImplementedError
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """In-memory iterator over arrays (the workhorse, reference
+    BaseDatasetIterator)."""
+
+    def __init__(self, features, labels, batch: int, *, mask=None,
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = False):
+        self.data = DataSet(features, labels, mask)
+        self.batch = batch
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+
+    def __iter__(self) -> Iterator[DataSet]:
+        ds = self.data
+        if self.shuffle:
+            ds = ds.shuffle(self.seed + self._epoch)
+        n = ds.num_examples()
+        for i in range(0, n, self.batch):
+            j = min(i + self.batch, n)
+            if j - i < self.batch:
+                if self.drop_last or j - i == 0:
+                    break
+                # Pad to batch size with wraparound so shapes stay static.
+                idx = np.concatenate(
+                    [np.arange(i, j), np.arange(0, self.batch - (j - i))])
+                yield ds._take(idx)
+            else:
+                yield ds._take(np.arange(i, j))
+
+    def reset(self) -> None:
+        self._epoch += 1
+
+    def batch_size(self) -> int:
+        return self.batch
+
+    def total_examples(self) -> int:
+        return self.data.num_examples()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays an underlying iterator N times (reference
+    MultipleEpochsIterator)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        self.epochs = epochs
+        self.base = base
+
+    def __iter__(self):
+        for _ in range(self.epochs):
+            yield from self.base
+            self.base.reset()
+
+    def reset(self):
+        self.base.reset()
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+    def total_examples(self):
+        return self.epochs * self.base.total_examples()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Random with-replacement sampling of batches (reference
+    SamplingDataSetIterator)."""
+
+    def __init__(self, data: DataSet, batch: int, num_batches: int, seed: int = 0):
+        self.data = data
+        self.batch = batch
+        self.num_batches = num_batches
+        self.rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        for _ in range(self.num_batches):
+            idx = self.rng.integers(0, self.data.num_examples(), self.batch)
+            yield self.data._take(idx)
+
+    def batch_size(self):
+        return self.batch
+
+    def total_examples(self):
+        return self.batch * self.num_batches

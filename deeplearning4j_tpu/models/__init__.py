@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+
+__all__ = ["MultiLayerNetwork"]

@@ -1,0 +1,1 @@
+"""Neural-network core: typed configs, pure-function layers, networks."""

@@ -1,0 +1,39 @@
+"""Helpers shared across layer implementations."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.layers import LayerConf
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.ops.initializers import init_weights
+
+
+def dense_params(conf: LayerConf, key: jax.Array, dtype) -> dict:
+    """W:[n_in,n_out], b:[n_out] — the "W"/"b" param keys of reference
+    DefaultParamInitializer.java:37."""
+    kw, _ = jax.random.split(key)
+    return {
+        "W": init_weights(kw, (conf.n_in, conf.n_out), conf.weight_init, dtype,
+                          conf.distribution),
+        "b": jnp.zeros((conf.n_out,), dtype),
+    }
+
+
+def apply_dropout(
+    x: jax.Array, rate: float, train: bool, rng: Optional[jax.Array]
+) -> jax.Array:
+    """Inverted dropout (reference util/Dropout.java applies masks scaled at
+    train time). No-op unless training with rate>0 and an rng is supplied."""
+    if not train or rate <= 0.0 or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+def activate(conf: LayerConf, z: jax.Array) -> jax.Array:
+    return get_activation(conf.activation)(z)

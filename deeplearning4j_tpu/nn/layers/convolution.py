@@ -1,0 +1,85 @@
+"""Convolution + subsampling (pooling) layers.
+
+Parity: reference ConvolutionLayer.java:49 (im2col + Convolution.conv2d via
+ND4J) and SubsamplingLayer.java:51 (MAX/AVG/SUM/NONE pooling). TPU-first
+re-design: NHWC layout + `lax.conv_general_dilated`, which XLA tiles directly
+onto the MXU — no im2col materialisation; pooling via `lax.reduce_window`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers import LayerImpl, register_layer_impl
+from deeplearning4j_tpu.nn.layers.common import activate, apply_dropout
+from deeplearning4j_tpu.ops.initializers import init_weights
+
+_DIMSPEC = ("NHWC", "HWIO", "NHWC")
+
+
+def conv_init(conf: L.ConvolutionLayerConf, key: jax.Array, dtype=jnp.float32):
+    kh, kw = conf.kernel_size
+    shape = (kh, kw, conf.n_in, conf.n_out)  # HWIO
+    k1, _ = jax.random.split(key)
+    params = {
+        "W": init_weights(k1, shape, conf.weight_init, dtype, conf.distribution),
+        "b": jnp.zeros((conf.n_out,), dtype),
+    }
+    return params, {}
+
+
+def conv_apply(conf, params, state, x, *, train=False, rng=None, mask=None):
+    x = apply_dropout(x, conf.dropout, train, rng)
+    dn = lax.conv_dimension_numbers(x.shape, params["W"].shape, _DIMSPEC)
+    z = lax.conv_general_dilated(
+        x, params["W"],
+        window_strides=conf.stride,
+        padding=conf.padding,
+        dimension_numbers=dn,
+    ) + params["b"]
+    return activate(conf, z), state
+
+
+register_layer_impl("convolutionlayer", LayerImpl(conv_init, conv_apply))
+
+
+def _pool_init(conf, key, dtype=jnp.float32):
+    return {}, {}
+
+
+def pool_apply(conf: L.SubsamplingLayerConf, params, state, x, *,
+               train=False, rng=None, mask=None):
+    kh, kw = conf.kernel_size
+    sh, sw = conf.stride
+    window = (1, kh, kw, 1)
+    strides = (1, sh, sw, 1)
+    kind = conf.pooling_type.lower()
+    if kind == "none":
+        return x, state
+    if kind == "max":
+        out = lax.reduce_window(
+            x, -jnp.inf, lax.max, window, strides, conf.padding
+        )
+    elif kind in ("avg", "sum"):
+        out = lax.reduce_window(
+            x, 0.0, lax.add, window, strides, conf.padding
+        )
+        if kind == "avg":
+            if conf.padding.upper() == "SAME":
+                # Divide border windows by their true coverage, not kh*kw —
+                # zero padding must not count as data.
+                counts = lax.reduce_window(
+                    jnp.ones_like(x), 0.0, lax.add, window, strides,
+                    conf.padding)
+                out = out / counts
+            else:
+                out = out / float(kh * kw)
+    else:
+        raise ValueError(f"Unknown pooling type: {conf.pooling_type}")
+    return activate(conf, out), state
+
+
+register_layer_impl("subsamplinglayer", LayerImpl(_pool_init, pool_apply))

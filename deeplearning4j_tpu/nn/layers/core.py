@@ -1,0 +1,121 @@
+"""Core feedforward layers: dense, output, batch-norm, embedding, dropout,
+activation.
+
+Parity: reference BaseLayer.preOutput() = x.mmul(W).addiRowVector(b)
+(BaseLayer.java:328-345) and activate() (:347-357); OutputLayer.java:57.
+The matmul maps straight onto the MXU; keep inputs batched and let XLA fuse
+the bias add + activation into the matmul epilogue.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers import LayerImpl, register_layer_impl
+from deeplearning4j_tpu.nn.layers.common import activate, apply_dropout, dense_params
+from deeplearning4j_tpu.ops.initializers import init_weights
+
+
+# ---- dense ---------------------------------------------------------------
+
+def dense_init(conf: L.DenseLayerConf, key: jax.Array, dtype=jnp.float32):
+    return dense_params(conf, key, dtype), {}
+
+
+def dense_apply(conf, params, state, x, *, train=False, rng=None, mask=None):
+    x = apply_dropout(x, conf.dropout, train, rng)
+    z = x @ params["W"] + params["b"]
+    return activate(conf, z), state
+
+
+register_layer_impl("denselayer", LayerImpl(dense_init, dense_apply))
+
+
+# ---- output --------------------------------------------------------------
+# Same forward as dense; the loss lives in the model-level objective, which
+# fuses softmax+CE on logits for stability (ops/losses mcxent_with_logits).
+
+register_layer_impl("outputlayer", LayerImpl(dense_init, dense_apply))
+
+
+def rnn_output_apply(conf, params, state, x, *, train=False, rng=None, mask=None):
+    # x: [batch, time, features] — apply the dense head per timestep.
+    x = apply_dropout(x, conf.dropout, train, rng)
+    z = jnp.einsum("bti,io->bto", x, params["W"]) + params["b"]
+    return activate(conf, z), state
+
+
+register_layer_impl("rnnoutputlayer", LayerImpl(dense_init, rnn_output_apply))
+
+
+# ---- batch norm ----------------------------------------------------------
+
+def batchnorm_init(conf: L.BatchNormConf, key: jax.Array, dtype=jnp.float32):
+    n = conf.n_out or conf.n_in
+    params = {"scale": jnp.ones((n,), dtype), "bias": jnp.zeros((n,), dtype)}
+    state = {"mean": jnp.zeros((n,), jnp.float32),
+             "var": jnp.ones((n,), jnp.float32)}
+    return params, state
+
+
+def batchnorm_apply(conf, params, state, x, *, train=False, rng=None, mask=None):
+    axes = tuple(range(x.ndim - 1))  # normalise over all but the channel axis
+    if train:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        m = conf.momentum
+        new_state = {
+            "mean": m * state["mean"] + (1 - m) * mean.astype(jnp.float32),
+            "var": m * state["var"] + (1 - m) * var.astype(jnp.float32),
+        }
+    else:
+        mean, var = state["mean"].astype(x.dtype), state["var"].astype(x.dtype)
+        new_state = state
+    inv = jax.lax.rsqrt(var.astype(x.dtype) + conf.epsilon)
+    y = (x - mean) * inv * params["scale"] + params["bias"]
+    return activate(conf, y), new_state
+
+
+register_layer_impl("batchnorm", LayerImpl(batchnorm_init, batchnorm_apply))
+
+
+# ---- embedding -----------------------------------------------------------
+
+def embedding_init(conf: L.EmbeddingLayerConf, key: jax.Array, dtype=jnp.float32):
+    tbl = init_weights(key, (conf.n_in, conf.n_out), conf.weight_init, dtype,
+                       conf.distribution)
+    return {"table": tbl}, {}
+
+
+def embedding_apply(conf, params, state, ids, *, train=False, rng=None, mask=None):
+    # ids: integer array of any shape -> [..., n_out]. jnp.take lowers to an
+    # XLA gather, which TPU executes natively.
+    out = jnp.take(params["table"], ids.astype(jnp.int32), axis=0)
+    return activate(conf, out), state
+
+
+register_layer_impl("embeddinglayer", LayerImpl(embedding_init, embedding_apply))
+
+
+# ---- dropout / activation-only ------------------------------------------
+
+def _stateless_init(conf, key, dtype=jnp.float32):
+    return {}, {}
+
+
+def dropout_apply(conf, params, state, x, *, train=False, rng=None, mask=None):
+    return apply_dropout(x, conf.dropout, train, rng), state
+
+
+register_layer_impl("dropoutlayer", LayerImpl(_stateless_init, dropout_apply))
+
+
+def activation_apply(conf, params, state, x, *, train=False, rng=None, mask=None):
+    return activate(conf, x), state
+
+
+register_layer_impl("activationlayer", LayerImpl(_stateless_init, activation_apply))

@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
+
+__all__ = ["make_mesh", "DataParallelTrainer"]

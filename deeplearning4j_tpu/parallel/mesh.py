@@ -1,0 +1,59 @@
+"""Device-mesh helpers.
+
+The reference's distribution fabric was Spark broadcast/accumulators, Akka
+actors over Hazelcast maps, and YARN Avro RPC (SURVEY §2.3) — all moving full
+dense parameter vectors through a central master, O(workers x params). The
+TPU-native fabric is a `jax.sharding.Mesh` over the chips: gradient exchange
+becomes `lax.pmean` over ICI, compiled into the step function itself; there
+is no master and no parameter server.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Sequence[str] = ("data",),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh over the available devices.
+
+    Default: 1-D data-parallel mesh over all devices. For hybrid
+    parallelism pass e.g. shape=(4, 2), axis_names=("data", "model").
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),)
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(
+            f"Mesh shape {shape} needs {int(np.prod(shape))} devices, "
+            f"have {len(devices)}")
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(mesh: Mesh, tree, axis: str = "data"):
+    """Place host arrays so dim 0 shards over the mesh's data axis."""
+    sh = batch_sharded(mesh, axis)
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, sh) if a is not None else None, tree,
+        is_leaf=lambda a: a is None)
+
+
+def replicate(mesh: Mesh, tree):
+    sh = replicated(mesh)
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), tree)

@@ -1,0 +1,231 @@
+"""MultiLayerNetwork end-to-end: convergence on Iris (reference
+MultiLayerTest.java:120 testBackProp style), LeNet shapes, LSTM, params
+pack/unpack, pretraining."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.fetchers import iris_dataset, synthetic_mnist
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (
+    AutoEncoderConf,
+    ConvolutionLayerConf,
+    DenseLayerConf,
+    GravesLSTMConf,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayerConf,
+    RBMConf,
+    RnnOutputLayerConf,
+    SubsamplingLayerConf,
+)
+
+
+def iris_mlp_conf(updater="adam", lr=0.01) -> MultiLayerConfiguration:
+    return MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=lr, updater=updater, seed=12),
+        layers=(
+            DenseLayerConf(n_in=4, n_out=16, activation="relu", weight_init="he"),
+            DenseLayerConf(n_in=16, n_out=16, activation="relu", weight_init="he"),
+            OutputLayerConf(n_in=16, n_out=3),
+        ),
+    )
+
+
+class TestIrisConvergence:
+    def test_backprop_reaches_f1(self):
+        # Reference MultiLayerTest.testBackProp: train on all 150, assert the
+        # evaluation is good. Quality gate from BASELINE.md: F1 >= 0.90.
+        ds = iris_dataset()
+        net = MultiLayerNetwork(iris_mlp_conf()).init()
+        it = ArrayDataSetIterator(ds.features, ds.labels, batch=30, seed=3)
+        net.fit(it, epochs=60)
+        ev = net.evaluate(ds.features, ds.labels)
+        assert ev.f1() >= 0.90, ev.stats()
+        assert ev.accuracy() >= 0.90
+
+    def test_loss_decreases(self):
+        ds = iris_dataset()
+        net = MultiLayerNetwork(iris_mlp_conf("sgd", 0.1)).init()
+        first = net.score(ds.features, ds.labels)
+        net.fit((ds.features, ds.labels), epochs=50)
+        assert net.score(ds.features, ds.labels) < first * 0.7
+
+
+class TestLeNetShapes:
+    def test_lenet_forward_and_train_step(self):
+        conf = MultiLayerConfiguration(
+            conf=NeuralNetConfiguration(learning_rate=0.01, updater="adam"),
+            layers=(
+                ConvolutionLayerConf(n_in=1, n_out=6, kernel_size=(5, 5),
+                                     padding="SAME"),
+                SubsamplingLayerConf(),
+                ConvolutionLayerConf(n_in=6, n_out=16, kernel_size=(5, 5)),
+                SubsamplingLayerConf(),
+                DenseLayerConf(n_in=400, n_out=120, activation="relu"),
+                DenseLayerConf(n_in=120, n_out=84, activation="relu"),
+                OutputLayerConf(n_in=84, n_out=10),
+            ),
+            input_preprocessors={"4": {"type": "cnn_to_ffn"}},
+        )
+        net = MultiLayerNetwork(conf).init()
+        ds = synthetic_mnist(64)
+        out = net.output(ds.features[:8])
+        assert out.shape == (8, 10)
+        np.testing.assert_allclose(np.sum(np.asarray(out), -1), 1.0, atol=1e-5)
+        loss0 = net.fit_batch(ds.features[:32], ds.labels[:32])
+        for _ in range(10):
+            loss = net.fit_batch(ds.features[:32], ds.labels[:32])
+        assert loss < loss0  # memorizing one batch must reduce loss
+
+
+class TestRecurrent:
+    def test_lstm_classification_last_step(self):
+        # Toy sequence task: classify by which half has larger mean.
+        rng = np.random.default_rng(0)
+        n, t, f = 128, 12, 8
+        x = rng.normal(size=(n, t, f)).astype(np.float32)
+        y_idx = (x[:, : t // 2].mean((1, 2)) > x[:, t // 2:].mean((1, 2))).astype(int)
+        y = np.eye(2, dtype=np.float32)[y_idx]
+        conf = MultiLayerConfiguration(
+            conf=NeuralNetConfiguration(learning_rate=0.01, updater="adam"),
+            layers=(
+                GravesLSTMConf(n_in=f, n_out=32),
+                OutputLayerConf(n_in=32, n_out=2),
+            ),
+            input_preprocessors={"1": {"type": "rnn_last_step"}},
+        )
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(60):
+            loss = net.fit_batch(x, y)
+        ev = net.evaluate(x, y)
+        assert ev.accuracy() >= 0.8, ev.stats()
+
+    def test_rnn_output_layer_per_timestep(self):
+        conf = MultiLayerConfiguration(
+            conf=NeuralNetConfiguration(learning_rate=0.05),
+            layers=(
+                GravesLSTMConf(n_in=4, n_out=8),
+                RnnOutputLayerConf(n_in=8, n_out=5),
+            ),
+        )
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(1).normal(size=(3, 7, 4)).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (3, 7, 5)
+
+    def test_masking_carries_state(self):
+        conf = MultiLayerConfiguration(
+            layers=(GravesLSTMConf(n_in=2, n_out=4),))
+        net = MultiLayerNetwork(conf).init()
+        x = np.ones((1, 5, 2), np.float32)
+        mask_full = np.ones((1, 5), np.float32)
+        mask_cut = np.array([[1, 1, 1, 0, 0]], np.float32)
+        out_full = np.asarray(net.output(x, mask=jnp.asarray(mask_full)))
+        out_cut = np.asarray(net.output(x, mask=jnp.asarray(mask_cut)))
+        # After the mask cuts off, hidden state freezes at step 2's value.
+        np.testing.assert_allclose(out_cut[0, 3], out_cut[0, 2], atol=1e-6)
+        np.testing.assert_allclose(out_cut[0, 4], out_cut[0, 2], atol=1e-6)
+        assert not np.allclose(out_full[0, 4], out_cut[0, 4])
+
+
+class TestParamsVector:
+    def test_pack_unpack_round_trip(self):
+        net = MultiLayerNetwork(iris_mlp_conf()).init()
+        vec = net.params_flat()
+        assert vec.shape == (net.num_params(),)
+        net2 = MultiLayerNetwork(iris_mlp_conf()).init(jax.random.PRNGKey(99))
+        assert not np.allclose(net2.params_flat(), vec)
+        net2.set_params_flat(vec)
+        np.testing.assert_array_equal(net2.params_flat(), vec)
+
+    def test_json_plus_params_ships_model(self):
+        # The universal model-shipping format (reference
+        # IterativeReduceFlatMap.java:73): conf JSON + flat params.
+        ds = iris_dataset()
+        net = MultiLayerNetwork(iris_mlp_conf()).init()
+        net.fit((ds.features, ds.labels), epochs=20)
+        js, vec = net.conf.to_json(), net.params_flat()
+        net2 = MultiLayerNetwork.from_json(js, vec)
+        np.testing.assert_allclose(
+            np.asarray(net.output(ds.features[:10])),
+            np.asarray(net2.output(ds.features[:10])), atol=1e-6)
+
+    def test_merge_parameter_averaging(self):
+        a = MultiLayerNetwork(iris_mlp_conf()).init(jax.random.PRNGKey(1))
+        b = MultiLayerNetwork(iris_mlp_conf()).init(jax.random.PRNGKey(2))
+        expected = (a.params_flat() + b.params_flat()) / 2
+        a.merge([b])
+        np.testing.assert_allclose(a.params_flat(), expected, atol=1e-6)
+
+
+class TestPretraining:
+    def test_autoencoder_pretrain_reduces_reconstruction(self):
+        from deeplearning4j_tpu.nn.layers.pretrain import ae_pretrain_loss
+
+        ds = iris_dataset().scale_0_1()
+        conf = MultiLayerConfiguration(
+            conf=NeuralNetConfiguration(learning_rate=0.01, updater="adam"),
+            layers=(AutoEncoderConf(n_in=4, n_out=8, corruption_level=0.1),
+                    OutputLayerConf(n_in=8, n_out=3)),
+            pretrain=True,
+        )
+        net = MultiLayerNetwork(conf).init()
+        rng = jax.random.PRNGKey(0)
+        before = float(ae_pretrain_loss(conf.layers[0], net.params[0],
+                                        jnp.asarray(ds.features), rng))
+        net.pretrain((ds.features, ds.labels), epochs=200)
+        after = float(ae_pretrain_loss(conf.layers[0], net.params[0],
+                                       jnp.asarray(ds.features), rng))
+        assert after < before
+
+    def test_rbm_cd_reduces_reconstruction_error(self):
+        from deeplearning4j_tpu.nn.layers.pretrain import rbm_pretrain_loss
+
+        rng = np.random.default_rng(0)
+        x = (rng.random((256, 16)) < 0.3).astype(np.float32)
+        conf = MultiLayerConfiguration(
+            conf=NeuralNetConfiguration(learning_rate=0.05, updater="sgd"),
+            layers=(RBMConf(n_in=16, n_out=8, k=1),),
+        )
+        net = MultiLayerNetwork(conf).init()
+        before = float(rbm_pretrain_loss(conf.layers[0], net.params[0],
+                                         jnp.asarray(x), None))
+        net.pretrain((x, x), epochs=150)
+        after = float(rbm_pretrain_loss(conf.layers[0], net.params[0],
+                                        jnp.asarray(x), None))
+        assert after < before
+
+    def test_dbn_pretrain_then_finetune(self):
+        # Reference testDbn: RBM stack pretrain + supervised finetune on Iris.
+        ds = iris_dataset().scale_0_1()
+        conf = MultiLayerConfiguration(
+            conf=NeuralNetConfiguration(learning_rate=0.02, updater="adam"),
+            layers=(RBMConf(n_in=4, n_out=12, hidden_unit="binary",
+                            visible_unit="gaussian"),
+                    OutputLayerConf(n_in=12, n_out=3)),
+            pretrain=True,
+        )
+        net = MultiLayerNetwork(conf).init()
+        it = ArrayDataSetIterator(ds.features, ds.labels, batch=50)
+        net.fit(it, epochs=80)
+        ev = net.evaluate(ds.features, ds.labels)
+        assert ev.accuracy() >= 0.85, ev.stats()
+
+
+class TestEvaluation:
+    def test_confusion_and_metrics_closed_form(self):
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        ev = Evaluation()
+        y = np.eye(2)[[0, 0, 1, 1]]
+        p = np.eye(2)[[0, 1, 1, 1]]
+        ev.eval(y, p)
+        assert ev.accuracy() == 0.75
+        assert ev.precision(1) == 2 / 3
+        assert ev.recall(0) == 0.5
+        assert ev.confusion.count(0, 1) == 1
+        assert "Accuracy" in ev.stats()

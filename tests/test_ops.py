@@ -113,19 +113,21 @@ class TestUpdaters:
         assert int(state["step"]) == 1
 
     @pytest.mark.parametrize(
-        "kind",
-        [Updater.ADAM, Updater.ADAGRAD, Updater.RMSPROP, Updater.ADADELTA,
-         Updater.NESTEROVS, Updater.LION, Updater.ADAMW],
+        "kind,steps",
+        [(Updater.ADAM, 50), (Updater.ADAGRAD, 50), (Updater.RMSPROP, 50),
+         (Updater.ADADELTA, 500), (Updater.NESTEROVS, 50), (Updater.LION, 50),
+         (Updater.ADAMW, 50)],
     )
-    def test_all_updaters_descend_quadratic(self, kind):
-        # Minimise f(w) = ||w||^2 — every updater must reduce it.
+    def test_all_updaters_descend_quadratic(self, kind, steps):
+        # Minimise f(w) = ||w||^2 — every updater must reduce it. AdaDelta's
+        # accumulator cold-start makes its early steps tiny, hence more steps.
         cfg = UpdaterConfig(updater=kind, learning_rate=0.05)
         tx = make_updater(cfg)
         w = jnp.array([1.0, -2.0, 3.0])
         state = tx.init(w)
         f = lambda w_: jnp.sum(jnp.square(w_))
         start = float(f(w))
-        for _ in range(50):
+        for _ in range(steps):
             g = jax.grad(f)(w)
             updates, state = tx.update(g, state, w)
             w = apply_updates(w, updates)

@@ -141,6 +141,8 @@ def svmlight_dataset(path: str, num_features: int,
             vec = np.zeros(num_features, np.float32)
             for tok in parts[1:]:
                 i, v = tok.split(":")
+                if not i.isdigit():  # skip qid:/cost: style meta tokens
+                    continue
                 vec[int(i) - 1] = float(v)  # svmlight is 1-indexed
             rows.append(vec)
     y = np.asarray(labels)

@@ -132,7 +132,12 @@ class MultiLayerNetwork:
 
     def add_listener(self, fn) -> None:
         """IterationListener parity (reference optimize/api/IterationListener):
-        fn(iteration:int, score:float)."""
+        either a plain fn(iteration:int, score:float) or an object with
+        iteration_done(model, iteration, score) (optimize.api listeners,
+        runtime.CheckpointListener)."""
+        if hasattr(fn, "iteration_done"):
+            obj = fn
+            fn = lambda it, score: obj.iteration_done(self, it, score)  # noqa: E731
         self._listeners.append(fn)
 
     # ---- functional forward ----------------------------------------------

@@ -1,0 +1,53 @@
+"""Optimization: convex solvers, line search, listeners, terminations.
+
+Parity target: reference `optimize/` (SURVEY §2.1) — `Solver.java:41` dispatch
+on `OptimizationAlgorithm.java:42` {LINE_GRADIENT_DESCENT, CONJUGATE_GRADIENT,
+HESSIAN_FREE, LBFGS, STOCHASTIC_GRADIENT_DESCENT}, shared loop
+`BaseOptimizer.java:124-196`, `BackTrackLineSearch.java`, termination
+conditions, and the `IterationListener` SPI.
+
+TPU-first re-design: each solver is a pure function over a FLAT parameter
+vector (the reference's own pack/unpack view) whose whole iteration —
+gradient, direction, line search — is one jitted XLA program; the host loop
+only fires listeners and checks termination between steps. Autodiff replaces
+the hand-written R-op machinery (`MultiLayerNetwork.java:655-1650`): the
+Hessian-free solver gets curvature products from `jax.jvp(jax.grad(f))`.
+"""
+
+from deeplearning4j_tpu.optimize.api import (
+    OptimizationAlgorithm,
+    IterationListener,
+    ComposableIterationListener,
+    ScoreIterationListener,
+)
+from deeplearning4j_tpu.optimize.line_search import backtrack_line_search
+from deeplearning4j_tpu.optimize.solvers import (
+    conjugate_gradient,
+    hessian_free,
+    lbfgs,
+    line_gradient_descent,
+    stochastic_gradient_descent,
+)
+from deeplearning4j_tpu.optimize.solver import Solver
+from deeplearning4j_tpu.optimize.terminations import (
+    EpsTermination,
+    Norm2Termination,
+    ZeroDirectionTermination,
+)
+
+__all__ = [
+    "OptimizationAlgorithm",
+    "IterationListener",
+    "ComposableIterationListener",
+    "ScoreIterationListener",
+    "backtrack_line_search",
+    "stochastic_gradient_descent",
+    "line_gradient_descent",
+    "conjugate_gradient",
+    "lbfgs",
+    "hessian_free",
+    "Solver",
+    "EpsTermination",
+    "Norm2Termination",
+    "ZeroDirectionTermination",
+]

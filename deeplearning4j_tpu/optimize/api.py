@@ -1,0 +1,69 @@
+"""Optimization API surface: algorithm enum + listener SPI.
+
+Parity: reference `nn/api/OptimizationAlgorithm.java:42` and
+`optimize/api/IterationListener.java` (fired from `BaseOptimizer.java:169`
+and `MultiLayerNetwork.java:1112`).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from typing import Callable, Sequence
+
+log = logging.getLogger(__name__)
+
+
+class OptimizationAlgorithm(str, enum.Enum):
+    STOCHASTIC_GRADIENT_DESCENT = "sgd"
+    LINE_GRADIENT_DESCENT = "line_gradient_descent"
+    CONJUGATE_GRADIENT = "conjugate_gradient"
+    LBFGS = "lbfgs"
+    HESSIAN_FREE = "hessian_free"
+
+
+class IterationListener:
+    """Callback fired once per optimizer iteration.
+
+    Same contract as the reference SPI: `iterationDone(model, iteration)`,
+    here enriched with the score so listeners need not recompute it.
+    """
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        raise NotImplementedError
+
+
+class ScoreIterationListener(IterationListener):
+    """Logs the score every `print_iterations` iterations
+    (reference `ScoreIterationListener.java:50`)."""
+
+    def __init__(self, print_iterations: int = 10,
+                 out: Callable[[str], None] | None = None):
+        self.print_iterations = max(1, print_iterations)
+        self._out = out or (lambda s: log.info(s))
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        if iteration % self.print_iterations == 0:
+            self._out(f"Score at iteration {iteration} is {score}")
+
+
+class ComposableIterationListener(IterationListener):
+    """Fans one callback out to many (reference
+    `ComposableIterationListener.java`)."""
+
+    def __init__(self, listeners: Sequence[IterationListener]):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        for listener in self.listeners:
+            listener.iteration_done(model, iteration, score)
+
+
+class CallbackListener(IterationListener):
+    """Adapts a plain function into an IterationListener."""
+
+    def __init__(self, fn: Callable[[object, int, float], None]):
+        self.fn = fn
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        self.fn(model, iteration, score)

@@ -1,0 +1,116 @@
+"""Solver: builder + dispatch driving a jitted solver step from a host loop.
+
+Parity: reference `optimize/Solver.java:41` (builder, `getOptimizer():56-71`
+dispatch on OptimizationAlgorithm) and the shared loop
+`BaseOptimizer.java:124-196` (gradient+score → direction/line search → step →
+terminations, listeners fired at :169-170).
+
+The per-iteration math runs as ONE jitted step (direction + line search +
+update compiled together); the host loop only fires listeners and evaluates
+termination conditions — the reference's semantics at XLA speed. Works on any
+objective `f(flat_params) -> scalar`; `Solver.for_model` adapts a
+MultiLayerNetwork + batch into that form via its unravel view.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.optimize import solvers as solvers_mod
+from deeplearning4j_tpu.optimize.api import (
+    IterationListener,
+    OptimizationAlgorithm,
+)
+from deeplearning4j_tpu.optimize.terminations import (
+    EpsTermination,
+    TerminationCondition,
+)
+
+_FACTORIES = {
+    OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+        solvers_mod.stochastic_gradient_descent,
+    OptimizationAlgorithm.LINE_GRADIENT_DESCENT:
+        solvers_mod.line_gradient_descent,
+    OptimizationAlgorithm.CONJUGATE_GRADIENT:
+        solvers_mod.conjugate_gradient,
+    OptimizationAlgorithm.LBFGS: solvers_mod.lbfgs,
+    OptimizationAlgorithm.HESSIAN_FREE: solvers_mod.hessian_free,
+}
+
+
+class Solver:
+    """Builder-style solver (ref Solver.Builder) over a flat-vector objective."""
+
+    def __init__(self, f: Callable[[jax.Array], jax.Array],
+                 algorithm: OptimizationAlgorithm | str =
+                 OptimizationAlgorithm.LINE_GRADIENT_DESCENT,
+                 num_iterations: int = 100,
+                 listeners: Sequence[IterationListener] = (),
+                 terminations: Sequence[TerminationCondition] = (),
+                 model=None,
+                 **algo_kwargs):
+        self.f = f
+        self.algorithm = OptimizationAlgorithm(algorithm)
+        self.num_iterations = num_iterations
+        self.listeners = list(listeners)
+        self.terminations = (list(terminations)
+                             or [EpsTermination(eps=1e-6, tolerance=1e-12)])
+        self.model = model
+        init, step = _FACTORIES[self.algorithm](f, **algo_kwargs)
+        self._init = jax.jit(init)
+        self._step = jax.jit(step)
+
+    # -- reference Solver.optimize() ---------------------------------------
+    def optimize(self, x0) -> np.ndarray:
+        state = self._init(jnp.asarray(x0))
+        f_old = float(state.fval)
+        for i in range(self.num_iterations):
+            state = self._step(state)
+            f_new = float(state.fval)
+            for listener in self.listeners:
+                listener.iteration_done(self.model, i, f_new)
+            grad = np.asarray(state.grad)
+            # Search direction for ZeroDirectionTermination: algorithm aux
+            # where it carries one (CG), else steepest descent.
+            direction = (np.asarray(state.aux.direction)
+                         if hasattr(state.aux, "direction") else -grad)
+            extras = {"grad": grad, "direction": direction}
+            if any(t.terminate(f_new, f_old, extras)
+                   for t in self.terminations):
+                break
+            f_old = f_new
+        self.final_state = state
+        return np.asarray(state.x)
+
+    # -- model adapter ------------------------------------------------------
+    @classmethod
+    def for_model(cls, net, x, y, mask=None, **kwargs) -> "Solver":
+        """Adapt a MultiLayerNetwork + fixed batch into a flat objective, so
+        full-batch solvers (LBFGS/CG/HF) can train it — the reference's
+        per-layer Solver usage (`BaseLayer.getOptimizer():244-252`)."""
+        from jax.flatten_util import ravel_pytree
+
+        flat0, unravel = ravel_pytree(net.params)
+        state = net.state
+        xj, yj = jnp.asarray(x), jnp.asarray(y)
+        rng = jax.random.PRNGKey(0)
+
+        def f(vec):
+            loss, _ = net._objective(unravel(vec), state, xj, yj, rng, mask)
+            return loss
+
+        solver = cls(f, model=net, **kwargs)
+        solver._x0 = np.asarray(flat0)
+        solver._unravel = unravel
+        return solver
+
+    def fit_model(self) -> float:
+        """Run optimize() from the model's current params and write the
+        result back into the model. Returns the final score."""
+        best = self.optimize(self._x0)
+        self.model.params = self._unravel(jnp.asarray(best))
+        return float(self.final_state.fval)

@@ -1,0 +1,46 @@
+"""Termination conditions for the host-driven Solver loop.
+
+Parity: reference `optimize/terminations/` — `EpsTermination.java`,
+`Norm2Termination.java`, `ZeroDirection.java`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class TerminationCondition:
+    def terminate(self, f_new: float, f_old: float, extras) -> bool:
+        raise NotImplementedError
+
+
+class EpsTermination(TerminationCondition):
+    """Stop when relative improvement falls below eps (ref EpsTermination)."""
+
+    def __init__(self, eps: float = 1e-4, tolerance: float = 1e-10):
+        self.eps = eps
+        self.tolerance = tolerance
+
+    def terminate(self, f_new: float, f_old: float, extras) -> bool:
+        if not np.isfinite(f_new) or not np.isfinite(f_old):
+            return False
+        return abs(f_old - f_new) <= self.tolerance + self.eps * abs(f_old)
+
+
+class Norm2Termination(TerminationCondition):
+    """Stop when the gradient 2-norm drops below the floor."""
+
+    def __init__(self, gradient_norm_floor: float = 1e-6):
+        self.floor = gradient_norm_floor
+
+    def terminate(self, f_new: float, f_old: float, extras) -> bool:
+        grad = extras.get("grad") if isinstance(extras, dict) else None
+        return grad is not None and float(np.linalg.norm(grad)) < self.floor
+
+
+class ZeroDirectionTermination(TerminationCondition):
+    """Stop when the search direction is the zero vector."""
+
+    def terminate(self, f_new: float, f_old: float, extras) -> bool:
+        d = extras.get("direction") if isinstance(extras, dict) else None
+        return d is not None and float(np.linalg.norm(d)) == 0.0

@@ -1,0 +1,27 @@
+"""Runtime services: checkpoint/serialization, control plane, launchers.
+
+Replaces the reference's scattered persistence/coordination tier —
+`ModelSavingActor`/`DefaultModelSaver` (Java serialization to disk),
+`Nd4j.write/writeTxt` (CLI param dumps), the Hazelcast/ZooKeeper state
+tracking, and the Akka/YARN job control (SURVEY §2.3, §5).
+"""
+
+from deeplearning4j_tpu.runtime.checkpoint import (
+    CheckpointListener,
+    DiskModelSaver,
+    ModelSaver,
+    load_checkpoint,
+    load_model,
+    save_checkpoint,
+    save_model,
+)
+
+__all__ = [
+    "save_model",
+    "load_model",
+    "save_checkpoint",
+    "load_checkpoint",
+    "ModelSaver",
+    "DiskModelSaver",
+    "CheckpointListener",
+]

@@ -1,0 +1,156 @@
+"""Solver tests on analytic functions — mirrors reference
+`optimize/solver/TestOptimizers.java` (sphere function et al.) and
+`BackTrackLineSearchTest.java`."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.optimize import (
+    OptimizationAlgorithm,
+    ScoreIterationListener,
+    Solver,
+    backtrack_line_search,
+    conjugate_gradient,
+    hessian_free,
+    lbfgs,
+    line_gradient_descent,
+    stochastic_gradient_descent,
+)
+from deeplearning4j_tpu.optimize.solvers import minimize
+
+
+def sphere(x):
+    return jnp.sum(x * x)
+
+
+def rosenbrock(x):
+    return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1.0 - x[:-1]) ** 2)
+
+
+def quadratic(x):
+    # Ill-conditioned convex quadratic.
+    scales = jnp.arange(1, x.shape[0] + 1, dtype=x.dtype)
+    return jnp.sum(scales * x * x)
+
+
+X0 = np.array([1.5, -2.0, 3.0, 0.5, -1.0], np.float32)
+
+
+class TestLineSearch:
+    def test_descent_accepts_step(self):
+        x = jnp.asarray(X0)
+        f0 = sphere(x)
+        g0 = jax.grad(sphere)(x)
+        res = backtrack_line_search(sphere, x, f0, g0, -g0)
+        assert float(res.step) > 0
+        assert float(res.f_new) < float(f0)
+
+    def test_non_descent_direction_rejected(self):
+        x = jnp.asarray(X0)
+        f0 = sphere(x)
+        g0 = jax.grad(sphere)(x)
+        res = backtrack_line_search(sphere, x, f0, g0, g0)  # ascent direction
+        assert float(res.step) == 0.0
+
+    def test_jittable(self):
+        @jax.jit
+        def run(x):
+            f0 = sphere(x)
+            g0 = jax.grad(sphere)(x)
+            return backtrack_line_search(sphere, x, f0, g0, -g0).f_new
+
+        assert float(run(jnp.asarray(X0))) < float(sphere(jnp.asarray(X0)))
+
+
+ALGOS = {
+    "sgd": lambda f: stochastic_gradient_descent(f, learning_rate=0.05),
+    "line_gd": line_gradient_descent,
+    "cg": conjugate_gradient,
+    "lbfgs": lbfgs,
+    "hf": hessian_free,
+}
+
+
+class TestSolversOnSphere:
+    @pytest.mark.parametrize("name", list(ALGOS))
+    def test_converges(self, name):
+        algo = ALGOS[name](sphere)
+        out = minimize(algo, jnp.asarray(X0), num_iterations=150)
+        assert float(out.fval) < 1e-3, f"{name}: f={float(out.fval)}"
+
+    @pytest.mark.parametrize("name", ["cg", "lbfgs", "hf"])
+    def test_fast_on_quadratic(self, name):
+        # Second-order-ish methods crack an ill-conditioned quadratic in
+        # few iterations where plain SGD would crawl.
+        algo = ALGOS[name](quadratic)
+        out = minimize(algo, jnp.asarray(X0), num_iterations=30)
+        assert float(out.fval) < 1e-5
+
+
+class TestMinimizeEarlyStop:
+    def test_tol_converges_not_single_step(self):
+        # Regression: f_prev=inf must not trigger the eps stop on iter 1.
+        algo = stochastic_gradient_descent(sphere, learning_rate=0.05)
+        out = minimize(algo, jnp.asarray(X0), num_iterations=200, tol=1e-9)
+        assert int(out.it) > 1
+        assert float(out.fval) < 1e-3
+        # And it does stop early once converged.
+        assert int(out.it) < 200
+
+
+class TestRosenbrock:
+    def test_lbfgs_rosenbrock(self):
+        x0 = jnp.zeros(4, jnp.float32)
+        algo = lbfgs(rosenbrock)
+        out = minimize(algo, x0, num_iterations=400)
+        assert float(out.fval) < 1e-2
+        np.testing.assert_allclose(np.asarray(out.x), np.ones(4), atol=0.1)
+
+
+class TestSolverDriver:
+    def test_listeners_and_termination(self):
+        scores = []
+
+        class Capture(ScoreIterationListener):
+            def __init__(self):
+                super().__init__(print_iterations=1,
+                                 out=lambda s: scores.append(s))
+
+        solver = Solver(sphere, algorithm="conjugate_gradient",
+                        num_iterations=100, listeners=[Capture()])
+        x = solver.optimize(X0)
+        assert np.linalg.norm(x) < 1e-2
+        assert scores  # listener fired
+        # EpsTermination should have stopped well before 100 iterations.
+        assert len(scores) < 100
+
+    def test_algorithm_enum_dispatch(self):
+        for algo in OptimizationAlgorithm:
+            solver = Solver(sphere, algorithm=algo, num_iterations=60)
+            x = solver.optimize(X0)
+            assert float(sphere(jnp.asarray(x))) < 1e-2, algo
+
+    def test_for_model_lbfgs_trains_iris_like(self):
+        from deeplearning4j_tpu.nn.conf import (
+            DenseLayerConf, MultiLayerConfiguration, NeuralNetConfiguration,
+            OutputLayerConf)
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        labels = (x[:, 0] + x[:, 1] > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[labels]
+        conf = MultiLayerConfiguration(
+            conf=NeuralNetConfiguration(seed=7),
+            layers=(DenseLayerConf(n_in=4, n_out=8, activation="tanh"),
+                    OutputLayerConf(n_in=8, n_out=2)))
+        net = MultiLayerNetwork(conf).init()
+        before = net.score(x, y)
+        solver = Solver.for_model(net, x, y, algorithm="lbfgs",
+                                  num_iterations=60)
+        after = solver.fit_model()
+        assert after < before * 0.5
+        acc = (net.predict(x) == labels).mean()
+        assert acc > 0.9

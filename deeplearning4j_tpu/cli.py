@@ -57,9 +57,11 @@ def _load_dataset(input_path: str, props: Dict[str, str]):
     if not fmt:
         fmt = ("csv" if input_path.endswith(".csv") else "svmlight")
     if fmt in ("svmlight", "svm", "libsvm"):
+        from deeplearning4j_tpu.datasets.fetchers import (
+            sniff_svmlight_features)
         n_features = int(props.get("input.num.features", 0))
         if not n_features:
-            n_features = _sniff_svmlight_features(input_path)
+            n_features = sniff_svmlight_features(input_path)
         return svmlight_dataset(
             input_path, n_features,
             num_classes=_opt_int(props.get("input.num.classes")))
@@ -74,22 +76,6 @@ def _load_dataset(input_path: str, props: Dict[str, str]):
 
 def _opt_int(v: Optional[str]) -> Optional[int]:
     return int(v) if v else None
-
-
-def _sniff_svmlight_features(path: str) -> int:
-    max_idx = 0
-    with open(path) as f:
-        for line in f:
-            line = line.split("#")[0].strip()
-            for tok in line.split()[1:]:
-                idx = tok.split(":")[0]
-                if idx.isdigit():  # skip qid:/cost: style meta tokens
-                    max_idx = max(max_idx, int(idx))
-    if max_idx == 0:
-        raise SystemExit(
-            f"could not infer feature count from {path!r}; "
-            f"set input.num.features in the -conf properties file")
-    return max_idx
 
 
 def _build_net(model_path: str):
@@ -118,14 +104,23 @@ def cmd_train(args) -> int:
     epochs = int(props.get("train.epochs", args.epochs))
     batch = int(props.get("train.batch.size", args.batch))
 
+    divisor = 1
     if args.runtime == "spmd":
         from deeplearning4j_tpu.parallel import DataParallelTrainer
         runner = DataParallelTrainer(net)
+        divisor = runner.n_devices
     else:
         runner = net
     t0 = time.time()
-    for _ in range(epochs):
-        for b in ds.shuffle().batch_by(batch):
+    for epoch in range(epochs):
+        for b in ds.shuffle(seed=epoch).batch_by(batch):
+            n = b.num_examples()
+            if n % divisor:
+                # SPMD shards the batch over the mesh; pad the tail batch
+                # by wrapping so every shard stays equally sized.
+                reps = (-n) % divisor
+                idx = np.concatenate([np.arange(n), np.arange(reps)])
+                b = type(b)(b.features[idx], b.labels[idx])
             runner.fit_batch(b.features, b.labels)
     elapsed = time.time() - t0
 

@@ -126,6 +126,23 @@ def csv_dataset(path: str, label_col: int = -1, num_classes: Optional[int] = Non
     return DataSet(features.astype(np.float32), one_hot(labels, k))
 
 
+def sniff_svmlight_features(path: str) -> int:
+    """Max feature index in an svmlight file (1-indexed) — the feature
+    count when none is configured. Skips qid:/cost: meta tokens."""
+    max_idx = 0
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            for tok in line.split()[1:]:
+                idx = tok.split(":")[0]
+                if idx.isdigit():
+                    max_idx = max(max_idx, int(idx))
+    if max_idx == 0:
+        raise ValueError(
+            f"could not infer feature count from {path!r}")
+    return max_idx
+
+
 def svmlight_dataset(path: str, num_features: int,
                      num_classes: Optional[int] = None) -> DataSet:
     """SVMLight/libsvm format (reference CLI default input format,

@@ -1,0 +1,55 @@
+"""NLP / embedding models.
+
+Parity target: reference `deeplearning4j-scaleout/deeplearning4j-nlp`
+(SURVEY §2.2, 18.8k LoC) — Word2Vec (skip-gram, hierarchical softmax +
+negative sampling), GloVe, ParagraphVectors, tokenizer/sentence/document
+iterator SPIs, vocab cache + Huffman coding, TF-IDF/BoW vectorizers, and
+word2vec-C-compatible vector serialization.
+
+TPU-first re-design (SURVEY §7 hard part #1): the reference trains
+embeddings with sparse, racy, per-word-pair saxpy updates across a thread
+pool (`InMemoryLookupTable.iterateSample:192`, Hogwild). Here training is
+dense-batched and deterministic: the host streams integer-encoded skip-gram
+pairs; ONE jitted step gathers embedding rows, evaluates the HS/NEG
+objective for the whole batch, and applies the sparse update through XLA's
+gather/scatter-add autodiff — the MXU sees big batched matmuls instead of
+rank-1 updates.
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizer,
+    DefaultTokenizerFactory,
+    EndingPreProcessor,
+    InputHomogenization,
+    NGramTokenizer,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    CollectionSentenceIterator,
+    FileSentenceIterator,
+    LabelAwareSentenceIterator,
+    LineSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabWord
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.vectorizers import CountVectorizer, TfidfVectorizer
+from deeplearning4j_tpu.nlp.serde import (
+    load_txt_vectors,
+    read_binary_model,
+    write_binary_model,
+    write_word_vectors,
+)
+
+__all__ = [
+    "DefaultTokenizer", "NGramTokenizer", "DefaultTokenizerFactory",
+    "NGramTokenizerFactory", "EndingPreProcessor", "InputHomogenization",
+    "CollectionSentenceIterator", "FileSentenceIterator",
+    "LineSentenceIterator", "LabelAwareSentenceIterator",
+    "VocabWord", "VocabCache", "Huffman",
+    "Word2Vec", "Glove", "ParagraphVectors",
+    "CountVectorizer", "TfidfVectorizer",
+    "write_word_vectors", "load_txt_vectors", "write_binary_model",
+    "read_binary_model",
+]

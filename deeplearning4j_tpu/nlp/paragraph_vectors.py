@@ -1,0 +1,169 @@
+"""ParagraphVectors (doc2vec), DBOW flavour on top of Word2Vec.
+
+Parity: reference `models/paragraphvectors/ParagraphVectors.java:61` —
+document labels live in the same vocab/lookup table as words (:64), and
+`dbow():295` trains the label's vector to predict each word of the
+document through the same HS/NEG objective as skip-gram. Inference for an
+unseen document gradient-descends a fresh vector against frozen output
+weights (a capability the reference lacked but doc2vec users expect).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, _log_sigmoid
+
+
+class ParagraphVectors(Word2Vec):
+    """DBOW paragraph vectors: labels as pseudo-words."""
+
+    LABEL_PREFIX = "LABEL_"  # keeps labels distinct from corpus words
+
+    def __init__(self, train_words: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.train_words = train_words
+        self.labels: List[str] = []
+
+    def fit_labelled(self, sentences: Sequence[str],
+                     labels: Sequence[str]) -> "ParagraphVectors":
+        if len(sentences) != len(labels):
+            raise ValueError("sentences and labels must align")
+        token_lists = self._sentences_to_tokens(sentences)
+        self.labels = sorted(set(labels))
+        # Labels enter the vocab as high-frequency pseudo-words so Huffman
+        # gives them short codes (reference: labels are VocabWords :64).
+        with_labels = list(token_lists)
+        for lab in self.labels:
+            with_labels.append([self.LABEL_PREFIX + lab])
+        self.build_vocab(with_labels)
+        self.reset_weights()
+
+        rng = np.random.default_rng(self.seed)
+        key = jax.random.PRNGKey(self.seed)
+        use_hs = self.negative == 0
+        syn0 = jnp.asarray(self.syn0)
+        out = jnp.asarray(self.syn1 if use_hs else self.syn1neg)
+        step = self._step
+
+        encoded = [self.vocab.encode(t) for t in token_lists]
+        label_idx = np.asarray(
+            [self.vocab.index_of(self.LABEL_PREFIX + l) for l in labels],
+            np.int32)
+
+        # DBOW pairs: (input=label, target=word) for every word of the doc;
+        # optionally also plain skip-gram pairs to train word vectors.
+        pairs = []
+        for li, sent in zip(label_idx, encoded):
+            for w in sent:
+                pairs.append((li, w))
+        arr = np.asarray(pairs, np.int32) if pairs else np.zeros((0, 2),
+                                                                np.int32)
+        if self.train_words:
+            arr = np.concatenate([arr, self._make_pairs(encoded, rng)])
+
+        B = self.batch_size
+        total = max(len(arr) * self.epochs, 1)
+        seen = 0
+        for epoch in range(self.epochs):
+            rng.shuffle(arr)
+            for s in range(0, len(arr), B):
+                chunk = arr[s:s + B]
+                n_real = len(chunk)
+                valid = np.ones(B, np.int32)
+                if n_real < B:
+                    valid[n_real:] = 0
+                    chunk = np.concatenate(
+                        [chunk, np.zeros((B - n_real, 2), np.int32)])
+                frac = min(seen / total, 1.0)
+                lr = max(self.learning_rate * (1 - frac),
+                         self.min_learning_rate)
+                key, sub = jax.random.split(key)
+                syn0, out, _ = step(syn0, out, jnp.asarray(chunk[:, 0]),
+                                    jnp.asarray(chunk[:, 1]),
+                                    jnp.float32(lr), sub,
+                                    jnp.asarray(valid))
+                seen += n_real
+        self.syn0 = np.asarray(syn0)
+        if use_hs:
+            self.syn1 = np.asarray(out)
+        else:
+            self.syn1neg = np.asarray(out)
+        self._norms = None
+        return self
+
+    # -- queries -----------------------------------------------------------
+    def get_label_vector(self, label: str) -> Optional[np.ndarray]:
+        return self.get_word_vector(self.LABEL_PREFIX + label)
+
+    def similarity_to_label(self, words: Sequence[str], label: str) -> float:
+        """Cosine between the mean word vector and a label vector."""
+        vecs = [v for w in words if (v := self.get_word_vector(w)) is not None]
+        lv = self.get_label_vector(label)
+        if not vecs or lv is None:
+            return float("nan")
+        mean = np.mean(vecs, axis=0)
+        denom = np.linalg.norm(mean) * np.linalg.norm(lv)
+        return float(np.dot(mean, lv) / max(denom, 1e-12))
+
+    def predict(self, words: Sequence[str]) -> Optional[str]:
+        """Nearest label for a tokenized document (reference
+        ParagraphVectors usage in sentiment examples)."""
+        scored = [(self.similarity_to_label(words, l), l)
+                  for l in self.labels]
+        scored = [(s, l) for s, l in scored if np.isfinite(s)]
+        return max(scored)[1] if scored else None
+
+    def infer_vector(self, words: Sequence[str], steps: int = 50,
+                     lr: float = 0.05) -> np.ndarray:
+        """Gradient-descend a fresh doc vector against frozen output
+        weights (DBOW objective)."""
+        idx = self.vocab.encode(list(words))
+        if len(idx) == 0:
+            return np.zeros(self.vector_length, np.float32)
+        use_hs = self.negative == 0
+        rng = np.random.default_rng(self.seed)
+        v = ((rng.random(self.vector_length) - 0.5)
+             / self.vector_length).astype(np.float32)
+        targets = jnp.asarray(idx)
+        if use_hs:
+            points, codes, lengths = self._hs
+            syn1 = jnp.asarray(self.syn1)
+
+            def loss_fn(vec):
+                p = points[targets]
+                c = codes[targets]
+                L = p.shape[1]
+                mask = (jnp.arange(L)[None, :]
+                        < lengths[targets][:, None]).astype(vec.dtype)
+                dots = jnp.einsum("d,nld->nl", vec, syn1[p])
+                sign = 1.0 - 2.0 * c.astype(vec.dtype)
+                return -jnp.sum(_log_sigmoid(sign * dots) * mask)
+        else:
+            syn1neg = jnp.asarray(self.syn1neg)
+            table = self._neg_table
+            K = self.negative
+            key = jax.random.PRNGKey(self.seed + 1)
+            negs = table[jax.random.randint(key, (len(idx), K), 0,
+                                            table.shape[0])]
+
+            def loss_fn(vec):
+                pos = syn1neg[targets]           # [N, D]
+                neg = syn1neg[negs]              # [N, K, D]
+                pos_ll = _log_sigmoid(pos @ vec)
+                neg_dot = jnp.einsum("nkd,d->nk", neg, vec)
+                collide = (negs == targets[:, None])
+                neg_ll = jnp.where(collide, 0.0, _log_sigmoid(-neg_dot))
+                # Full contrastive NEG objective — without the negative
+                # term the optimum is an unbounded-norm vector.
+                return -(jnp.sum(pos_ll) + jnp.sum(neg_ll))
+
+        grad = jax.jit(jax.grad(loss_fn))
+        vec = jnp.asarray(v)
+        for _ in range(steps):
+            vec = vec - lr * grad(vec)
+        return np.asarray(vec)

@@ -1,0 +1,86 @@
+"""Text vectorizers: bag-of-words counts and TF-IDF → DataSet.
+
+Parity: reference `bagofwords/vectorizer/` — `BaseTextVectorizer.java`,
+`CountVectorizer`, `TfidfVectorizer` (vectorize(text, label) → DataSet).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import one_hot
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+class BaseTextVectorizer:
+    def __init__(self, min_word_frequency: int = 1,
+                 tokenizer_factory: Optional[TokenizerFactory] = None):
+        self.vocab = VocabCache(min_word_frequency=min_word_frequency)
+        self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
+        self._doc_freq: Dict[str, int] = {}
+        self.num_docs = 0
+
+    def fit(self, documents: Sequence[str]) -> "BaseTextVectorizer":
+        token_lists = [self.tokenizer.tokenize(d) for d in documents]
+        self.vocab.fit(token_lists)
+        self.num_docs = len(token_lists)
+        for toks in token_lists:
+            for w in set(toks):
+                if self.vocab.contains(w):
+                    self._doc_freq[w] = self._doc_freq.get(w, 0) + 1
+        return self
+
+    def _row(self, tokens: Sequence[str]) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform(self, documents: Sequence[str]) -> np.ndarray:
+        return np.stack([self._row(self.tokenizer.tokenize(d))
+                         for d in documents])
+
+    def vectorize(self, documents: Sequence[str],
+                  labels: Sequence[int],
+                  num_classes: Optional[int] = None) -> DataSet:
+        """text+label → DataSet (reference TextVectorizer.vectorize)."""
+        x = self.transform(documents)
+        y = np.asarray(labels, int)
+        k = num_classes or int(y.max()) + 1
+        return DataSet(x.astype(np.float32), one_hot(y, k))
+
+
+class CountVectorizer(BaseTextVectorizer):
+    """Raw term counts (reference CountVectorizer)."""
+
+    def _row(self, tokens):
+        row = np.zeros(len(self.vocab), np.float32)
+        for t in tokens:
+            i = self.vocab.index_of(t)
+            if i >= 0:
+                row[i] += 1.0
+        return row
+
+
+class TfidfVectorizer(BaseTextVectorizer):
+    """TF-IDF weights (reference TfidfVectorizer: tf * log(N/df))."""
+
+    def _row(self, tokens):
+        row = np.zeros(len(self.vocab), np.float32)
+        if not tokens:
+            return row
+        for t in tokens:
+            i = self.vocab.index_of(t)
+            if i >= 0:
+                row[i] += 1.0
+        row /= max(len(tokens), 1)
+        for w, df in self._doc_freq.items():
+            i = self.vocab.index_of(w)
+            if i >= 0 and row[i] > 0:
+                row[i] *= math.log(max(self.num_docs, 1) / df)
+        return row

@@ -1,0 +1,277 @@
+"""Word2Vec: skip-gram with hierarchical softmax and/or negative sampling,
+dense-batched for TPU.
+
+Parity: reference `models/word2vec/Word2Vec.java:59` (fit():103 — vocab
+build → Huffman → training loop; `skipGram():319`; `iterate():342`) and the
+HS/NEG inner loop `InMemoryLookupTable.iterateSample:192` with its expTable
+sigmoid LUT, unigram^0.75 negative table, and linear learning-rate decay
+floored at minLearningRate.
+
+TPU-first re-design (SURVEY §7 hard part #1): the reference trains via
+sparse per-pair saxpy updates, racy across a thread pool (Hogwild). Here:
+
+- the host encodes sentences to int32 arrays once, then per epoch emits
+  skip-gram (input, target) pairs with the word2vec dynamic-window trick,
+  packed into fixed-size batches (static shapes → one XLA program);
+- ONE jitted step evaluates the whole batch: embedding gathers, a [B,L]
+  batched dot against the Huffman path rows (HS) and/or [B,K] negatives
+  gathered from the unigram table, exact `log_sigmoid` instead of the
+  1000-entry LUT, masked sum;
+- gradients reach syn0/syn1 through XLA's gather→scatter-add autodiff:
+  the update is mathematically the reference's sparse saxpy, but batched,
+  deterministic, and fused by the compiler;
+- Hogwild's lock-free parallelism maps to data-parallel batch sharding —
+  shard the pair stream over the mesh and psum the gradients
+  (`parallel.data_parallel` pattern), which is *more* synchronous than the
+  reference, not less.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import (
+    Huffman,
+    VocabCache,
+    build_negative_table,
+)
+from deeplearning4j_tpu.nlp.word_vectors import WordVectors
+
+
+def _log_sigmoid(x):
+    # Stable log sigmoid; replaces the reference's clipped expTable LUT
+    # (InMemoryLookupTable.java:173-177, MAX_EXP=6).
+    return -jax.nn.softplus(-x)
+
+
+class Word2Vec(WordVectors):
+    """Skip-gram word embeddings (reference Word2Vec.java defaults:
+    layerSize 100, window 5, alpha .025, minLearningRate 1e-2*alpha,
+    negative sampling off → hierarchical softmax on)."""
+
+    def __init__(self,
+                 vector_length: int = 100,
+                 window: int = 5,
+                 min_word_frequency: int = 1,
+                 learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4,
+                 negative: int = 0,
+                 subsample: float = 0.0,
+                 batch_size: int = 2048,
+                 epochs: int = 1,
+                 seed: int = 42,
+                 tokenizer_factory: Optional[TokenizerFactory] = None):
+        self.vector_length = vector_length
+        self.window = window
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.negative = negative
+        self.subsample = subsample
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.seed = seed
+        self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
+        vocab = VocabCache(min_word_frequency=min_word_frequency)
+        super().__init__(vocab, np.zeros((0, vector_length), np.float32))
+        self.syn1: Optional[np.ndarray] = None      # HS inner nodes
+        self.syn1neg: Optional[np.ndarray] = None   # NEG output vectors
+        self._hs = None  # (points, codes, lengths) device arrays
+        self._neg_table = None
+        self._step = None  # jitted train step, built in reset_weights
+
+    # ------------------------------------------------------------------
+    # vocab + weights
+
+    def _sentences_to_tokens(self, sentences) -> List[List[str]]:
+        out = []
+        for s in sentences:
+            out.append(self.tokenizer.tokenize(s) if isinstance(s, str)
+                       else list(s))
+        return out
+
+    def build_vocab(self, token_lists: Sequence[Sequence[str]]) -> None:
+        self.vocab.fit(token_lists)
+        if len(self.vocab) == 0:
+            raise ValueError("empty vocabulary — corpus too small or "
+                             "min_word_frequency too high")
+        Huffman(self.vocab).build()
+
+    def reset_weights(self) -> None:
+        """syn0 uniform in [-.5,.5]/D, syn1 zeros — reference
+        `InMemoryLookupTable.resetWeights():94-100`."""
+        rng = np.random.default_rng(self.seed)
+        V, D = len(self.vocab), self.vector_length
+        self.syn0 = ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
+        self.syn1 = np.zeros((max(V - 1, 1), D), np.float32)
+        if self.negative > 0:
+            self.syn1neg = np.zeros((V, D), np.float32)
+            self._neg_table = jnp.asarray(build_negative_table(self.vocab))
+        points, codes, lengths = self.vocab.hs_arrays()
+        self._hs = (jnp.asarray(points), jnp.asarray(codes),
+                    jnp.asarray(lengths))
+        self._norms = None
+        self._step = (self._build_neg_step() if self.negative > 0
+                      else self._build_hs_step())
+
+    # ------------------------------------------------------------------
+    # pair generation (host side; reference skipGram():319)
+
+    def _make_pairs(self, encoded: List[np.ndarray], rng: np.random.Generator
+                    ) -> np.ndarray:
+        """All (input=context, target=center) pairs for one epoch with the
+        word2vec reduced-window trick; subsampling of frequent words if
+        configured. Returns int32 [N, 2]."""
+        total = self.vocab.total_word_count()
+        keep_prob = None
+        if self.subsample > 0:
+            freq = np.array([self.vocab.word_frequency(self.vocab.word_at(i))
+                             for i in range(len(self.vocab))], np.float64)
+            ratio = freq / (self.subsample * total)
+            keep_prob = np.minimum((np.sqrt(ratio) + 1) / ratio, 1.0)
+        pairs = []
+        for sent in encoded:
+            if keep_prob is not None and len(sent):
+                keep = rng.random(len(sent)) < keep_prob[sent]
+                sent = sent[keep]
+            n = len(sent)
+            if n < 2:
+                continue
+            b = rng.integers(0, self.window, n)  # reduced window per center
+            for i in range(n):
+                lo = max(0, i - (self.window - b[i]))
+                hi = min(n, i + (self.window - b[i]) + 1)
+                for j in range(lo, hi):
+                    if j != i:
+                        pairs.append((sent[j], sent[i]))
+        if not pairs:
+            return np.zeros((0, 2), np.int32)
+        arr = np.asarray(pairs, np.int32)
+        rng.shuffle(arr)
+        return arr
+
+    # ------------------------------------------------------------------
+    # jitted training steps
+
+    def _build_hs_step(self):
+        points, codes, lengths = self._hs
+        L = points.shape[1]
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def hs_step(syn0, syn1, inputs, targets, lr, key, valid):
+            def loss_fn(s0, s1):
+                h = s0[inputs]                   # [B, D] input vectors
+                p = points[targets]              # [B, L] inner-node path
+                c = codes[targets]               # [B, L] branch bits
+                mask = (jnp.arange(L)[None, :]
+                        < lengths[targets][:, None]).astype(h.dtype)
+                mask = mask * valid[:, None].astype(h.dtype)  # pad rows off
+                w = s1[p]                        # [B, L, D]
+                dots = jnp.einsum("bd,bld->bl", h, w)
+                # label 1 for code 0 (sign trick: s = 1 - 2*code)
+                sign = 1.0 - 2.0 * c.astype(h.dtype)
+                return -jnp.sum(_log_sigmoid(sign * dots) * mask)
+
+            loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                syn0, syn1)
+            return syn0 - lr * g0, syn1 - lr * g1, loss
+
+        return hs_step
+
+    def _build_neg_step(self):
+        K = self.negative
+        table = self._neg_table
+        T = table.shape[0]
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def neg_step(syn0, syn1neg, inputs, targets, lr, key, valid):
+            B = inputs.shape[0]
+            idx = jax.random.randint(key, (B, K), 0, T)
+            negs = table[idx]                    # [B, K]
+
+            def loss_fn(s0, s1n):
+                h = s0[inputs]                   # [B, D]
+                pos = s1n[targets]               # [B, D]
+                neg = s1n[negs]                  # [B, K, D]
+                pos_dot = jnp.sum(h * pos, axis=1)
+                neg_dot = jnp.einsum("bd,bkd->bk", h, neg)
+                # Collisions with the true target get masked out.
+                collide = (negs == targets[:, None])
+                neg_ll = jnp.where(collide, 0.0, _log_sigmoid(-neg_dot))
+                v = valid.astype(h.dtype)        # pad rows contribute zero
+                return -(jnp.sum(_log_sigmoid(pos_dot) * v)
+                         + jnp.sum(neg_ll * v[:, None]))
+
+            loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                syn0, syn1neg)
+            return syn0 - lr * g0, syn1neg - lr * g1, loss
+
+        return neg_step
+
+    # ------------------------------------------------------------------
+    # fit (reference Word2Vec.fit():103)
+
+    def fit(self, sentences) -> "Word2Vec":
+        token_lists = self._sentences_to_tokens(sentences)
+        if len(self.vocab) == 0:
+            self.build_vocab(token_lists)
+        if self.syn0.shape[0] != len(self.vocab):
+            self.reset_weights()
+        encoded = [self.vocab.encode(t) for t in token_lists]
+        rng = np.random.default_rng(self.seed)
+        key = jax.random.PRNGKey(self.seed)
+
+        use_hs = self.negative == 0
+        syn0 = jnp.asarray(self.syn0)
+        out = jnp.asarray(self.syn1 if use_hs else self.syn1neg)
+        step = self._step
+
+        total_pairs = None
+        seen = 0
+        for epoch in range(self.epochs):
+            pairs = self._make_pairs(encoded, rng)
+            if total_pairs is None:
+                total_pairs = max(len(pairs) * self.epochs, 1)
+            B = self.batch_size
+            # Pad the tail batch to keep ONE compiled step (static shapes).
+            n_full = (len(pairs) + B - 1) // B
+            for bi in range(n_full):
+                chunk = pairs[bi * B:(bi + 1) * B]
+                n_real = len(chunk)
+                valid = np.ones(B, np.int32)
+                if n_real < B:
+                    # Pad the tail to the compiled shape; the valid mask
+                    # zeroes the fake rows' loss so no spurious updates.
+                    valid[n_real:] = 0
+                    pad = np.zeros((B - n_real, 2), np.int32)
+                    chunk = np.concatenate([chunk, pad])
+                # Linear LR decay by pairs seen (reference `alpha` decay,
+                # Word2Vec.java:231-238), floored at min_learning_rate.
+                frac = min(seen / total_pairs, 1.0)
+                lr = max(self.learning_rate * (1 - frac),
+                         self.min_learning_rate)
+                key, sub = jax.random.split(key)
+                syn0, out, _ = step(syn0, out,
+                                    jnp.asarray(chunk[:, 0]),
+                                    jnp.asarray(chunk[:, 1]),
+                                    jnp.float32(lr), sub,
+                                    jnp.asarray(valid))
+                seen += n_real
+        self.syn0 = np.asarray(syn0)
+        if use_hs:
+            self.syn1 = np.asarray(out)
+        else:
+            self.syn1neg = np.asarray(out)
+        self._norms = None
+        return self
+
+    # reference naming
+    train = fit

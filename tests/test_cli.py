@@ -85,6 +85,18 @@ def test_properties_file_overrides(iris_svmlight, model_json, tmp_path,
     assert "Trained 2 epochs" in capsys.readouterr().out
 
 
+def test_spmd_runtime_handles_remainder_batches(iris_svmlight, model_json,
+                                                tmp_path, capsys):
+    # 150 examples / batch 32 → final batch of 22, not divisible by the
+    # 8-device test mesh; the CLI must pad it rather than crash.
+    out = tmp_path / "out"
+    rc = main(["train", "-input", str(iris_svmlight), "-model",
+               str(model_json), "-output", str(out), "-epochs", "2",
+               "-batch", "32", "-runtime", "spmd"])
+    assert rc == 0
+    assert "examples/sec" in capsys.readouterr().out
+
+
 def test_csv_input(model_json, tmp_path, capsys):
     ds = iris_dataset()
     csv = tmp_path / "iris.csv"

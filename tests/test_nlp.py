@@ -1,0 +1,256 @@
+"""NLP tests — mirrors reference `Word2VecTests.java` (train on corpus,
+assert wordsNearest/similarity), `GloveTest`, `ParagraphVectorsTest`,
+`WordVectorSerializerTest`, tokenizer tests, `Huffman` behaviour."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    CollectionSentenceIterator,
+    CountVectorizer,
+    DefaultTokenizer,
+    DefaultTokenizerFactory,
+    EndingPreProcessor,
+    Glove,
+    Huffman,
+    InputHomogenization,
+    LineSentenceIterator,
+    NGramTokenizer,
+    ParagraphVectors,
+    TfidfVectorizer,
+    VocabCache,
+    Word2Vec,
+    load_txt_vectors,
+    read_binary_model,
+    write_binary_model,
+    write_word_vectors,
+)
+
+
+# ---------------------------------------------------------------------------
+# A synthetic two-topic corpus: fruit words co-occur, tech words co-occur.
+# Big enough for embeddings to separate the topics deterministically.
+
+FRUIT = ["apple", "banana", "cherry", "mango", "grape"]
+TECH = ["cpu", "gpu", "ram", "disk", "cache"]
+
+
+def make_corpus(n=600, seed=0):
+    rng = np.random.default_rng(seed)
+    sentences = []
+    for i in range(n):
+        topic = FRUIT if i % 2 == 0 else TECH
+        words = rng.choice(topic, size=6)
+        sentences.append(" ".join(words))
+    return sentences
+
+
+CORPUS = make_corpus()
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        t = DefaultTokenizer("Hello world foo")
+        assert t.count_tokens() == 3
+        assert t.get_tokens() == ["Hello", "world", "foo"]
+        assert t.has_more_tokens()
+        assert t.next_token() == "Hello"
+
+    def test_ngram(self):
+        t = NGramTokenizer("a b c", min_n=1, max_n=2)
+        assert t.get_tokens() == ["a", "b", "c", "a b", "b c"]
+
+    def test_ending_preprocessor(self):
+        p = EndingPreProcessor()
+        assert p("apples") == "apple"
+        assert p("running") == "runn"
+
+    def test_input_homogenization(self):
+        assert InputHomogenization().transform("Héllo, World!") == "hello world"
+
+
+class TestSentenceIterators:
+    def test_collection(self):
+        it = CollectionSentenceIterator(["a b", "c d"],
+                                        pre_processor=str.upper)
+        assert list(it) == ["A B", "C D"]
+        assert list(it) == ["A B", "C D"]  # restartable
+
+    def test_line_file(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text("one\n\ntwo\nthree\n")
+        assert list(LineSentenceIterator(p)) == ["one", "two", "three"]
+
+
+class TestVocabHuffman:
+    def test_vocab_build_and_ordering(self):
+        vocab = VocabCache(min_word_frequency=2)
+        vocab.fit([["a", "a", "a", "b", "b", "c"]])
+        assert vocab.contains("a") and vocab.contains("b")
+        assert not vocab.contains("c")  # below min frequency
+        assert vocab.index_of("a") == 0  # most frequent first
+
+    def test_huffman_codes_prefix_free_and_frequency_ordered(self):
+        vocab = VocabCache()
+        for word, count in [("the", 100), ("of", 60), ("cat", 10),
+                            ("dog", 9), ("zebu", 1)]:
+            vocab.add(word, count)
+        Huffman(vocab).build()
+        codes = {w: "".join(map(str, vocab.words[w].codes))
+                 for w in vocab.words}
+        # prefix-free
+        for w1, c1 in codes.items():
+            for w2, c2 in codes.items():
+                if w1 != w2:
+                    assert not c2.startswith(c1), (w1, w2)
+        # frequent words get codes no longer than rare ones
+        assert len(codes["the"]) <= len(codes["zebu"])
+
+    def test_hs_arrays_shapes(self):
+        vocab = VocabCache()
+        vocab.fit([["a", "b", "c", "a", "b", "a"]])
+        Huffman(vocab).build()
+        points, codes, lengths = vocab.hs_arrays()
+        V = len(vocab)
+        assert points.shape == codes.shape
+        assert lengths.shape == (V,)
+        assert (points < V - 1).all()  # inner-node ids fit syn1
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("negative", [0, 5])
+    def test_topics_separate(self, negative):
+        w2v = Word2Vec(vector_length=24, window=3, epochs=5, seed=1,
+                       negative=negative, batch_size=512,
+                       learning_rate=0.025)
+        w2v.fit(CORPUS)
+        within = w2v.similarity("apple", "banana")
+        across = w2v.similarity("apple", "gpu")
+        assert within > across + 0.2, (within, across)
+        nearest = w2v.words_nearest("cpu", top_n=4)
+        assert set(nearest) <= set(TECH) - {"cpu"}
+
+    def test_oov_and_similarity_nan(self):
+        w2v = Word2Vec(vector_length=8, epochs=1)
+        w2v.fit(CORPUS[:50])
+        assert w2v.get_word_vector("notaword") is None
+        assert np.isnan(w2v.similarity("apple", "notaword"))
+
+
+class TestGlove:
+    def test_topics_separate(self):
+        glove = Glove(vector_length=16, window=4, epochs=30, seed=3,
+                      x_max=10.0)
+        glove.fit(CORPUS)
+        assert glove.losses[-1] < glove.losses[0]
+        within = glove.similarity("apple", "cherry")
+        across = glove.similarity("apple", "ram")
+        assert within > across, (within, across)
+
+
+class TestParagraphVectors:
+    def test_label_prediction(self):
+        labels = ["fruit" if i % 2 == 0 else "tech"
+                  for i in range(len(CORPUS))]
+        pv = ParagraphVectors(vector_length=24, window=3, epochs=5, seed=2,
+                              batch_size=512, learning_rate=0.025)
+        pv.fit_labelled(CORPUS, labels)
+        assert pv.predict(["apple", "banana", "grape"]) == "fruit"
+        assert pv.predict(["cpu", "disk", "cache"]) == "tech"
+
+    def test_infer_vector(self):
+        labels = ["fruit" if i % 2 == 0 else "tech"
+                  for i in range(len(CORPUS))]
+        pv = ParagraphVectors(vector_length=24, window=3, epochs=5, seed=2,
+                              batch_size=512, learning_rate=0.025)
+        pv.fit_labelled(CORPUS, labels)
+        vec = pv.infer_vector(["mango", "grape", "apple"])
+        assert vec.shape == (24,)
+        fr = pv.get_label_vector("fruit")
+        te = pv.get_label_vector("tech")
+        cos = lambda a, b: float(np.dot(a, b) /  # noqa: E731
+                                 (np.linalg.norm(a) * np.linalg.norm(b)))
+        assert cos(vec, fr) > cos(vec, te)
+
+
+class TestVectorizers:
+    DOCS = ["apple banana apple", "cpu gpu cpu gpu", "banana cherry"]
+
+    def test_count(self):
+        cv = CountVectorizer().fit(self.DOCS)
+        x = cv.transform(["apple apple gpu"])
+        assert x[0, cv.vocab.index_of("apple")] == 2
+        assert x[0, cv.vocab.index_of("gpu")] == 1
+
+    def test_tfidf_downweights_common(self):
+        docs = ["the apple", "the banana", "the cpu"]
+        tf = TfidfVectorizer().fit(docs)
+        x = tf.transform(["the apple"])
+        # 'the' appears in all docs → idf 0; 'apple' in one → positive.
+        assert x[0, tf.vocab.index_of("the")] == pytest.approx(0.0)
+        assert x[0, tf.vocab.index_of("apple")] > 0
+
+    def test_vectorize_dataset(self):
+        cv = CountVectorizer().fit(self.DOCS)
+        ds = cv.vectorize(self.DOCS, [0, 1, 0])
+        assert ds.features.shape[0] == 3
+        assert ds.labels.shape == (3, 2)
+
+
+class TestInvertedIndexAndWindows:
+    def test_inverted_index(self):
+        from deeplearning4j_tpu.nlp.invertedindex import InvertedIndex
+
+        idx = InvertedIndex()
+        d0 = idx.add_doc(["apple", "banana"])
+        d1 = idx.add_doc(["apple", "cpu"])
+        assert idx.documents("apple") == [d0, d1]
+        assert idx.documents("cpu") == [d1]
+        assert idx.num_documents() == 2
+        batches = list(idx.sample_batches(4, 3, seed=1))
+        assert len(batches) == 3 and len(batches[0]) == 4
+
+    def test_windows(self):
+        from deeplearning4j_tpu.nlp.windows import BEGIN, END, windows
+
+        ws = windows(["a", "b", "c"], window_size=3)
+        assert len(ws) == 3
+        assert ws[0].words == [BEGIN, "a", "b"]
+        assert ws[0].focus == "a"
+        assert ws[2].words == ["b", "c", END]
+
+
+class TestSerde:
+    def _small_wv(self):
+        w2v = Word2Vec(vector_length=12, epochs=1, seed=5)
+        w2v.fit(CORPUS[:100])
+        return w2v
+
+    def test_txt_round_trip(self, tmp_path):
+        wv = self._small_wv()
+        path = tmp_path / "vec.txt"
+        write_word_vectors(wv, path)
+        loaded = load_txt_vectors(path)
+        assert len(loaded.vocab) == len(wv.vocab)
+        w = wv.vocab.word_at(0)
+        np.testing.assert_allclose(loaded.get_word_vector(w),
+                                   wv.get_word_vector(w), rtol=1e-4)
+
+    def test_binary_round_trip(self, tmp_path):
+        wv = self._small_wv()
+        path = tmp_path / "vec.bin"
+        write_binary_model(wv, path)
+        loaded = read_binary_model(path)
+        assert len(loaded.vocab) == len(wv.vocab)
+        for i in (0, len(wv.vocab) - 1):
+            w = wv.vocab.word_at(i)
+            np.testing.assert_allclose(loaded.get_word_vector(w),
+                                       wv.get_word_vector(w), atol=1e-6)
+
+    def test_analogy_api(self):
+        wv = self._small_wv()
+        out = wv.analogy("apple", "banana", "cherry", top_n=3)
+        assert isinstance(out, list)

@@ -61,7 +61,12 @@ def _load_dataset(input_path: str, props: Dict[str, str]):
             sniff_svmlight_features)
         n_features = int(props.get("input.num.features", 0))
         if not n_features:
-            n_features = sniff_svmlight_features(input_path)
+            try:
+                n_features = sniff_svmlight_features(input_path)
+            except ValueError as e:
+                raise SystemExit(
+                    f"{e} — set input.num.features in the -conf "
+                    "properties file") from e
         return svmlight_dataset(
             input_path, n_features,
             num_classes=_opt_int(props.get("input.num.classes")))
@@ -119,7 +124,7 @@ def cmd_train(args) -> int:
                 # SPMD shards the batch over the mesh; pad the tail batch
                 # by wrapping so every shard stays equally sized.
                 reps = (-n) % divisor
-                idx = np.concatenate([np.arange(n), np.arange(reps)])
+                idx = np.concatenate([np.arange(n), np.arange(reps) % n])
                 b = type(b)(b.features[idx], b.labels[idx])
             runner.fit_batch(b.features, b.labels)
     elapsed = time.time() - t0

@@ -91,13 +91,15 @@ class Glove(WordVectors):
         lr = self.learning_rate
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, adagrad, ii, jj, xx):
+        def step(params, adagrad, ii, jj, xx, valid):
             def loss_fn(p):
                 w, wc, b, bc = p
                 diff = (jnp.sum(w[ii] * wc[jj], axis=1) + b[ii] + bc[jj]
                         - jnp.log(xx))
                 fx = jnp.minimum((xx / x_max) ** alpha, 1.0)
-                return 0.5 * jnp.sum(fx * diff * diff)
+                # `valid` zeroes rows padded in to keep one compiled shape,
+                # so duplicated tail pairs contribute no gradient.
+                return 0.5 * jnp.sum(valid * fx * diff * diff)
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
             # Per-element AdaGrad (reference GloveWeightLookupTable).
@@ -140,11 +142,15 @@ class Glove(WordVectors):
             total = 0.0
             for s in range(0, len(order), B):
                 sel = order[s:s + B]
+                valid = np.ones(B, np.float32)
                 if len(sel) < B:  # pad to keep one compiled shape
-                    sel = np.concatenate([sel, order[:B - len(sel)]])
+                    valid[len(sel):] = 0.0
+                    pad = np.arange(B - len(sel)) % len(order)
+                    sel = np.concatenate([sel, order[pad]])
                 params, adagrad, loss = step(
                     params, adagrad, jnp.asarray(ii[sel]),
-                    jnp.asarray(jj[sel]), jnp.asarray(xx[sel]))
+                    jnp.asarray(jj[sel]), jnp.asarray(xx[sel]),
+                    jnp.asarray(valid))
                 total += float(loss)
             self.losses.append(total)
         w, wc, _, _ = (np.asarray(p) for p in params)

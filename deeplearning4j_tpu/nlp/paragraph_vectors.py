@@ -35,11 +35,15 @@ class ParagraphVectors(Word2Vec):
             raise ValueError("sentences and labels must align")
         token_lists = self._sentences_to_tokens(sentences)
         self.labels = sorted(set(labels))
-        # Labels enter the vocab as high-frequency pseudo-words so Huffman
-        # gives them short codes (reference: labels are VocabWords :64).
+        # Labels enter the vocab as pseudo-words (reference: labels are
+        # VocabWords :64). Their count is the number of DBOW training pairs
+        # they appear in (= doc length), floored at min_word_frequency so the
+        # vocab filter can never silently drop a label.
         with_labels = list(token_lists)
-        for lab in self.labels:
-            with_labels.append([self.LABEL_PREFIX + lab])
+        floor = max(self.vocab.min_word_frequency, 1)
+        for toks, lab in zip(token_lists, labels):
+            with_labels.append(
+                [self.LABEL_PREFIX + lab] * max(len(toks), floor))
         self.build_vocab(with_labels)
         self.reset_weights()
 
@@ -54,6 +58,8 @@ class ParagraphVectors(Word2Vec):
         label_idx = np.asarray(
             [self.vocab.index_of(self.LABEL_PREFIX + l) for l in labels],
             np.int32)
+        if (label_idx < 0).any():
+            raise AssertionError("label missing from vocab after build")
 
         # DBOW pairs: (input=label, target=word) for every word of the doc;
         # optionally also plain skip-gram pairs to train word vectors.

@@ -26,6 +26,7 @@ class BaseTextVectorizer:
         self.vocab = VocabCache(min_word_frequency=min_word_frequency)
         self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
         self._doc_freq: Dict[str, int] = {}
+        self._idf = np.zeros(0, np.float32)
         self.num_docs = 0
 
     def fit(self, documents: Sequence[str]) -> "BaseTextVectorizer":
@@ -36,6 +37,10 @@ class BaseTextVectorizer:
             for w in set(toks):
                 if self.vocab.contains(w):
                     self._doc_freq[w] = self._doc_freq.get(w, 0) + 1
+        self._idf = np.zeros(len(self.vocab), np.float32)
+        for w, df in self._doc_freq.items():
+            self._idf[self.vocab.index_of(w)] = math.log(
+                max(self.num_docs, 1) / df)
         return self
 
     def _row(self, tokens: Sequence[str]) -> np.ndarray:
@@ -79,8 +84,4 @@ class TfidfVectorizer(BaseTextVectorizer):
             if i >= 0:
                 row[i] += 1.0
         row /= max(len(tokens), 1)
-        for w, df in self._doc_freq.items():
-            i = self.vocab.index_of(w)
-            if i >= 0 and row[i] > 0:
-                row[i] *= math.log(max(self.num_docs, 1) / df)
-        return row
+        return row * self._idf

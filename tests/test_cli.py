@@ -97,6 +97,18 @@ def test_spmd_runtime_handles_remainder_batches(iris_svmlight, model_json,
     assert "examples/sec" in capsys.readouterr().out
 
 
+def test_spmd_pad_longer_than_tail(iris_svmlight, model_json, tmp_path,
+                                   capsys):
+    # 150 % 148 → tail batch of 2 on an 8-device mesh needs 6 pad rows,
+    # MORE than the tail itself — padding must wrap modulo the batch.
+    out = tmp_path / "out"
+    rc = main(["train", "-input", str(iris_svmlight), "-model",
+               str(model_json), "-output", str(out), "-epochs", "1",
+               "-batch", "148", "-runtime", "spmd"])
+    assert rc == 0
+    assert "examples/sec" in capsys.readouterr().out
+
+
 def test_csv_input(model_json, tmp_path, capsys):
     ds = iris_dataset()
     csv = tmp_path / "iris.csv"

@@ -161,6 +161,17 @@ class TestParagraphVectors:
         assert pv.predict(["apple", "banana", "grape"]) == "fruit"
         assert pv.predict(["cpu", "disk", "cache"]) == "tech"
 
+    def test_labels_survive_min_word_frequency(self):
+        # Regression: labels are once-per-doc pseudo-words; the vocab filter
+        # must not drop them when min_word_frequency > 1.
+        labels = ["fruit" if i % 2 == 0 else "tech"
+                  for i in range(len(CORPUS))]
+        pv = ParagraphVectors(vector_length=16, window=3, epochs=2, seed=2,
+                              batch_size=256, min_word_frequency=2)
+        pv.fit_labelled(CORPUS, labels)
+        assert pv.get_label_vector("fruit") is not None
+        assert pv.get_label_vector("tech") is not None
+
     def test_infer_vector(self):
         labels = ["fruit" if i % 2 == 0 else "tech"
                   for i in range(len(CORPUS))]

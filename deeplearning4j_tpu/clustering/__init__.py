@@ -1,0 +1,27 @@
+"""Clustering + spatial search structures.
+
+Parity: reference `deeplearning4j-core/.../clustering/` (SURVEY §2.1) —
+KMeans (`kmeans/KMeansClustering.java:31`, strategy-driven loop in
+`BaseClusteringAlgorithm.java`), KDTree (`kdtree/KDTree.java`), VPTree
+(`vptree/VPTree.java`, backs the UI nearest-neighbors resource), QuadTree
+(`quadtree/QuadTree.java`) and SpTree (`sptree/SpTree.java`, Barnes-Hut).
+
+TPU split: KMeans is the FLOP-heavy part (distance matrices) and runs as a
+jitted `lax.while_loop` on device; the trees are pointer-chasing host
+structures (numpy) used for nearest-neighbor serving and Barnes-Hut t-SNE.
+"""
+
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering, kmeans_fit
+from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.clustering.quadtree import QuadTree
+from deeplearning4j_tpu.clustering.sptree import SpTree
+
+__all__ = [
+    "KMeansClustering",
+    "kmeans_fit",
+    "KDTree",
+    "VPTree",
+    "QuadTree",
+    "SpTree",
+]

@@ -1,0 +1,27 @@
+"""Visualization math + renderers.
+
+Parity: reference `deeplearning4j-core/.../plot/` (SURVEY §2.1) — exact
+t-SNE (`Tsne.java:208`), Barnes-Hut t-SNE (`BarnesHutTsne.java:62`), weight/
+activation renderers (`NeuralNetPlotter.java`, `FilterRenderer.java`) and
+plotting iteration listeners. The reference shells out to bundled Python
+matplotlib scripts; here matplotlib is called directly and the t-SNE
+gradient loop is a single jitted `lax.fori_loop` on device.
+"""
+
+from deeplearning4j_tpu.plot.tsne import Tsne, tsne_fit
+from deeplearning4j_tpu.plot.barnes_hut_tsne import BarnesHutTsne
+from deeplearning4j_tpu.plot.renderers import FilterRenderer, NeuralNetPlotter
+from deeplearning4j_tpu.plot.listeners import (
+    ActivationRenderListener,
+    PlotFiltersIterationListener,
+)
+
+__all__ = [
+    "Tsne",
+    "tsne_fit",
+    "BarnesHutTsne",
+    "FilterRenderer",
+    "NeuralNetPlotter",
+    "ActivationRenderListener",
+    "PlotFiltersIterationListener",
+]

@@ -1,0 +1,164 @@
+"""Exact t-SNE, device-resident.
+
+Parity: reference `plot/Tsne.java:49` — `computeGaussianPerplexity():127`
+(per-point binary search for the Gaussian beta hitting the target
+perplexity) and `calculate():208` (gradient loop with momentum + adaptive
+per-element gains, early exaggeration). The reference runs both as Java
+loops over INDArrays; here the perplexity search is a vmapped
+`lax.while_loop` and the whole gradient descent is one jitted
+`lax.fori_loop` — the O(n^2) affinity/repulsion matrices are exactly the
+kind of dense work the MXU wants.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+EPS = 1e-12
+
+
+def _sq_dists(x: jax.Array) -> jax.Array:
+    # HIGHEST precision: the TPU MXU's default bf16 matmul loses ~|x|^2*2^-8
+    # absolute accuracy, which breaks self-distance==0 and destabilizes the
+    # gradient loop. These are small [n,n] matrices — full f32 is cheap.
+    n2 = jnp.sum(x * x, axis=1)
+    d2 = (n2[:, None] + n2[None, :]
+          - 2.0 * jnp.matmul(x, x.T, precision=jax.lax.Precision.HIGHEST))
+    d2 = jnp.maximum(d2, 0.0)
+    return d2 * (1.0 - jnp.eye(d2.shape[0], dtype=d2.dtype))
+
+
+def _row_affinities(d2_row: jax.Array, i: int, perplexity: float,
+                    tol: float = 1e-5, max_iter: int = 50):
+    """Binary-search beta for one row (Tsne.java:127's hBeta loop)."""
+    log_u = jnp.log(perplexity)
+    mask = jnp.arange(d2_row.shape[0]) != i
+
+    def entropy_p(beta):
+        p = jnp.where(mask, jnp.exp(-d2_row * beta), 0.0)
+        sum_p = jnp.maximum(jnp.sum(p), EPS)
+        h = jnp.log(sum_p) + beta * jnp.sum(d2_row * p) / sum_p
+        return h, p / sum_p
+
+    def cond(state):
+        it, beta, lo, hi = state
+        h, _ = entropy_p(beta)
+        return jnp.logical_and(it < max_iter, jnp.abs(h - log_u) > tol)
+
+    def body(state):
+        it, beta, lo, hi = state
+        h, _ = entropy_p(beta)
+        too_high = h > log_u  # entropy too high -> narrow the Gaussian
+        new_lo = jnp.where(too_high, beta, lo)
+        new_hi = jnp.where(too_high, hi, beta)
+        new_beta = jnp.where(
+            too_high,
+            jnp.where(jnp.isinf(new_hi), beta * 2.0, (beta + new_hi) / 2.0),
+            jnp.where(jnp.isinf(new_lo), beta / 2.0, (beta + new_lo) / 2.0))
+        return it + 1, new_beta, new_lo, new_hi
+
+    _, beta, _, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.asarray(0), jnp.asarray(1.0), jnp.asarray(-jnp.inf),
+         jnp.asarray(jnp.inf)))
+    _, p = entropy_p(beta)
+    return p
+
+
+def gaussian_perplexity(x: jax.Array, perplexity: float) -> jax.Array:
+    """Symmetrized input affinity matrix P [n,n]."""
+    d2 = _sq_dists(jnp.asarray(x, jnp.float32))
+    n = d2.shape[0]
+    rows = jax.vmap(
+        lambda row, i: _row_affinities(row, i, perplexity)
+    )(d2, jnp.arange(n))
+    p = rows + rows.T
+    return jnp.maximum(p / jnp.maximum(jnp.sum(p), EPS), EPS)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_components", "n_iter", "stop_lying_iter"))
+def tsne_fit(
+    x: jax.Array,
+    key: jax.Array,
+    n_components: int = 2,
+    perplexity: float = 30.0,
+    learning_rate: float = 500.0,
+    n_iter: int = 1000,
+    initial_momentum: float = 0.5,
+    final_momentum: float = 0.8,
+    switch_momentum_iter: int = 250,
+    stop_lying_iter: int = 250,
+    exaggeration: float = 4.0,
+    min_gain: float = 0.01,
+):
+    """Full exact-t-SNE run under one jit. Returns Y [n, n_components]."""
+    p = gaussian_perplexity(x, perplexity)
+    n = p.shape[0]
+    y0 = 1e-4 * jax.random.normal(key, (n, n_components), jnp.float32)
+
+    def step(it, carry):
+        y, dy, gains = carry
+        d2 = _sq_dists(y)
+        num = 1.0 / (1.0 + d2)
+        num = num * (1.0 - jnp.eye(n))
+        q = jnp.maximum(num / jnp.maximum(jnp.sum(num), EPS), EPS)
+        p_eff = jnp.where(it < stop_lying_iter, p * exaggeration, p)
+        pq = (p_eff - q) * num                      # [n,n]
+        grad = 4.0 * jnp.matmul(jnp.diag(jnp.sum(pq, axis=1)) - pq, y,
+                                precision=jax.lax.Precision.HIGHEST)
+        momentum = jnp.where(it < switch_momentum_iter, initial_momentum,
+                             final_momentum)
+        same_sign = jnp.sign(grad) == jnp.sign(dy)
+        gains = jnp.maximum(
+            jnp.where(same_sign, gains * 0.8, gains + 0.2), min_gain)
+        dy = momentum * dy - learning_rate * gains * grad
+        y = y + dy
+        return y - jnp.mean(y, axis=0), dy, gains
+
+    y, _, _ = jax.lax.fori_loop(
+        0, n_iter, step,
+        (y0, jnp.zeros_like(y0), jnp.ones_like(y0)))
+    return y
+
+
+class Tsne:
+    """Builder-style surface mirroring Tsne.java's Builder."""
+
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 learning_rate: float = 500.0, n_iter: int = 1000,
+                 seed: int = 0, **kwargs):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.n_iter = n_iter
+        self.seed = seed
+        self.kwargs = kwargs
+        self.y: Optional[np.ndarray] = None
+
+    def calculate(self, x) -> np.ndarray:
+        self.y = np.asarray(tsne_fit(
+            jnp.asarray(x, jnp.float32), jax.random.PRNGKey(self.seed),
+            n_components=self.n_components, perplexity=self.perplexity,
+            learning_rate=self.learning_rate, n_iter=self.n_iter,
+            **self.kwargs))
+        return self.y
+
+    fit_transform = calculate
+
+    def save_coords(self, path: str, labels=None) -> None:
+        """CSV of coords(,label) — the format the UI t-SNE resource serves."""
+        if self.y is None:
+            raise ValueError("calculate() first")
+        with open(path, "w", encoding="utf-8") as f:
+            for i, row in enumerate(self.y):
+                cells = [f"{v:.6f}" for v in row]
+                if labels is not None:
+                    cells.append(str(labels[i]))
+                f.write(",".join(cells) + "\n")

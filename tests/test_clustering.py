@@ -1,0 +1,153 @@
+"""Clustering + spatial-tree tests (reference: KDTreeTest, VpTreeNodeTest,
+QuadTreeTest, SPTreeTest, KMeans usage in BaseClusteringAlgorithm tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    KDTree,
+    KMeansClustering,
+    QuadTree,
+    SpTree,
+    VPTree,
+    kmeans_fit,
+)
+
+
+def _blobs(seed=0, n_per=50, centers=((0, 0), (10, 10), (-10, 10))):
+    rng = np.random.default_rng(seed)
+    pts, labels = [], []
+    for i, c in enumerate(centers):
+        pts.append(rng.normal(c, 0.5, size=(n_per, len(c))))
+        labels += [i] * n_per
+    return np.concatenate(pts).astype(np.float32), np.asarray(labels)
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        pts, labels = _blobs()
+        km = KMeansClustering.setup(3, max_iter=50)
+        assign = km.fit(pts)
+        # each true blob maps to exactly one cluster id
+        for lbl in range(3):
+            ids = assign[labels == lbl]
+            assert len(set(ids.tolist())) == 1
+        assert km.centers.shape == (3, 2)
+        # centers near the true blob centers (orderless)
+        found = sorted(km.centers.round(0).tolist())
+        assert found == sorted([[0, 0], [10, 10], [-10, 10]])
+
+    def test_predict_matches_fit(self):
+        pts, _ = _blobs(seed=1)
+        km = KMeansClustering.setup(3)
+        assign = km.fit(pts)
+        np.testing.assert_array_equal(km.predict(pts), assign)
+
+    def test_converges_before_max_iter(self):
+        import jax
+
+        pts, _ = _blobs(seed=2)
+        _, _, n_iter = kmeans_fit(pts, 3, jax.random.PRNGKey(0), max_iter=100)
+        assert int(n_iter) < 100
+
+
+def _brute_knn(points, q, k):
+    d = np.linalg.norm(points - q, axis=1)
+    return sorted(np.argsort(d)[:k].tolist())
+
+
+class TestKDTree:
+    def test_knn_matches_brute_force(self):
+        rng = np.random.default_rng(0)
+        pts = rng.random((200, 3))
+        tree = KDTree.build(pts)
+        for q in rng.random((10, 3)):
+            got = sorted(i for _, _, i in tree.knn(q, 5))
+            assert got == _brute_knn(pts, q, 5)
+
+    def test_incremental_insert_nn(self):
+        tree = KDTree(2)
+        pts = [(0, 0), (1, 1), (5, 5), (2, 2)]
+        for p in pts:
+            tree.insert(p)
+        dist, point, idx = tree.nn((1.1, 1.1))
+        assert idx == 1
+        assert dist == pytest.approx(np.sqrt(0.02), abs=1e-9)
+
+    def test_range_query(self):
+        tree = KDTree.build([[0, 0], [1, 1], [2, 2], [5, 5]])
+        inside = {i for _, i in tree.range([0.5, 0.5], [2.5, 2.5])}
+        assert inside == {1, 2}
+
+
+class TestVPTree:
+    def test_knn_matches_brute_force(self):
+        rng = np.random.default_rng(1)
+        pts = rng.random((150, 4))
+        tree = VPTree(pts)
+        for q in rng.random((10, 4)):
+            got = sorted(lbl for _, lbl in tree.knn(q, 4))
+            assert got == _brute_knn(pts, q, 4)
+
+    def test_words_nearest_cosine(self):
+        words = ["king", "queen", "apple", "pear"]
+        vecs = np.array([[1, 0.1], [0.9, 0.2], [-1, 0.5], [-0.9, 0.4]])
+        tree = VPTree(vecs, labels=words, distance="cosine")
+        assert tree.words_nearest([1.0, 0.15], 2) == ["king", "queen"]
+
+
+class TestQuadTree:
+    def test_insert_and_size(self):
+        pts = np.random.default_rng(2).random((64, 2))
+        tree = QuadTree(pts)
+        assert len(tree) == 64
+        np.testing.assert_allclose(tree.cum_center, pts.mean(0), atol=1e-9)
+
+    def test_non_edge_forces_match_exact_small_theta(self):
+        pts = np.random.default_rng(3).random((30, 2)) * 4
+        tree = QuadTree(pts)
+        i = 7
+        # theta=0 forces full recursion -> exact repulsion
+        neg, sum_q = tree.compute_non_edge_forces(i, pts[i], theta=0.0)
+        diff = pts[i] - np.delete(pts, i, 0)
+        q = 1.0 / (1.0 + np.sum(diff**2, 1))
+        np.testing.assert_allclose(sum_q, q.sum(), rtol=1e-8)
+        np.testing.assert_allclose(neg, (q[:, None] ** 2 * diff).sum(0),
+                                   rtol=1e-8)
+
+
+class TestSpTree:
+    def test_size_and_center_of_mass(self):
+        pts = np.random.default_rng(4).random((100, 3))
+        tree = SpTree(pts)
+        assert len(tree) == 100
+        np.testing.assert_allclose(tree.cum_center, pts.mean(0), atol=1e-9)
+
+    def test_exact_forces_at_theta_zero(self):
+        pts = np.random.default_rng(5).random((40, 3)) * 2
+        tree = SpTree(pts)
+        for i in (0, 13, 39):
+            neg, sum_q = tree.compute_non_edge_forces(i, theta=0.0)
+            diff = pts[i] - np.delete(pts, i, 0)
+            q = 1.0 / (1.0 + np.sum(diff**2, 1))
+            np.testing.assert_allclose(sum_q, q.sum(), rtol=1e-8)
+            np.testing.assert_allclose(neg, (q[:, None] ** 2 * diff).sum(0),
+                                       rtol=1e-8)
+
+    def test_approximation_close_at_half_theta(self):
+        pts = np.random.default_rng(6).random((120, 2)) * 10
+        tree = SpTree(pts)
+        neg_a, q_a = tree.compute_non_edge_forces(5, theta=0.5)
+        neg_e, q_e = tree.compute_non_edge_forces(5, theta=0.0)
+        assert q_a == pytest.approx(q_e, rel=0.1)
+        np.testing.assert_allclose(neg_a, neg_e, atol=0.1 * np.abs(neg_e).max())
+
+    def test_edge_forces(self):
+        pts = np.asarray([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0]])
+        tree = SpTree(pts)
+        row_p = np.asarray([0, 2, 3, 4])
+        col_p = np.asarray([1, 2, 0, 0])
+        val_p = np.asarray([0.5, 0.5, 0.5, 0.5])
+        pos = tree.compute_edge_forces(row_p, col_p, val_p)
+        exp0 = 0.5 * 0.5 * (pts[0] - pts[1]) + 0.5 * 0.5 * (pts[0] - pts[2])
+        np.testing.assert_allclose(pos[0], exp0)

@@ -1,0 +1,115 @@
+"""t-SNE + renderer tests (reference: plot/TsneTest.java, BarnesHutTsneTest
+on the bundled mnist2500 fixture — here a synthetic blob fixture keeps the
+suite fast while asserting the same property: clusters separate in 2-D)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.plot import (
+    BarnesHutTsne,
+    FilterRenderer,
+    NeuralNetPlotter,
+    PlotFiltersIterationListener,
+    Tsne,
+)
+from deeplearning4j_tpu.plot.tsne import gaussian_perplexity
+
+
+def _three_blobs(n_per=20, dim=10, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 8, size=(3, dim))
+    x = np.concatenate([rng.normal(c, 0.3, size=(n_per, dim))
+                        for c in centers])
+    labels = np.repeat(np.arange(3), n_per)
+    return x.astype(np.float32), labels
+
+
+def _separation(y, labels):
+    """min inter-centroid distance / max intra-cluster spread."""
+    cents = np.stack([y[labels == i].mean(0) for i in range(3)])
+    inter = min(np.linalg.norm(cents[i] - cents[j])
+                for i in range(3) for j in range(i + 1, 3))
+    intra = max(np.linalg.norm(y[labels == i] - cents[i], axis=1).max()
+                for i in range(3))
+    return inter / max(intra, 1e-9)
+
+
+def test_gaussian_perplexity_rows_valid():
+    x, _ = _three_blobs()
+    p = np.asarray(gaussian_perplexity(x, perplexity=10.0))
+    assert p.shape == (60, 60)
+    assert np.all(p >= 0)
+    assert np.isclose(p.sum(), 1.0, atol=1e-3)
+    np.testing.assert_allclose(p, p.T, atol=1e-6)
+
+
+def test_exact_tsne_separates_blobs():
+    x, labels = _three_blobs()
+    tsne = Tsne(perplexity=10.0, n_iter=300, learning_rate=100.0)
+    y = tsne.calculate(x)
+    assert y.shape == (60, 2)
+    assert np.all(np.isfinite(y))
+    assert _separation(y, labels) > 1.5
+
+
+def test_exact_tsne_save_coords(tmp_path):
+    x, labels = _three_blobs(n_per=5)
+    tsne = Tsne(perplexity=3.0, n_iter=50)
+    tsne.calculate(x)
+    path = tmp_path / "coords.csv"
+    tsne.save_coords(str(path), labels)
+    lines = path.read_text().strip().split("\n")
+    assert len(lines) == 15
+    assert lines[0].count(",") == 2
+
+
+def test_barnes_hut_tsne_separates_blobs():
+    x, labels = _three_blobs(n_per=15)
+    bh = BarnesHutTsne(perplexity=5.0, n_iter=150, theta=0.5)
+    y = bh.fit_transform(x)
+    assert y.shape == (45, 2)
+    assert np.all(np.isfinite(y))
+    assert _separation(y, labels) > 1.0
+
+
+def test_filter_renderer(tmp_path):
+    w = np.random.default_rng(0).random((16, 9))
+    path = tmp_path / "filters.png"
+    grid = FilterRenderer().render(w, str(path))
+    assert grid.ndim == 2
+    assert path.exists() or (tmp_path / "filters.npy").exists()
+
+
+def test_neural_net_plotter(tmp_path):
+    params = {"0": {"W": np.random.default_rng(1).random((4, 3)),
+                    "b": np.zeros(3)}}
+    grads = {"0": {"W": np.random.default_rng(2).random((4, 3)) * 0.01,
+                   "b": np.zeros(3)}}
+    written = NeuralNetPlotter().plot_network_gradient(
+        params, grads, str(tmp_path))
+    for p in written:
+        import os
+        assert os.path.exists(p)
+
+
+def test_plot_listener_fires(tmp_path):
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayerConf,
+        MultiLayerConfiguration,
+        NeuralNetConfiguration,
+        OutputLayerConf,
+    )
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+
+    conf = MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=0.1),
+        layers=(DenseLayerConf(n_in=4, n_out=8),
+                OutputLayerConf(n_in=8, n_out=3)))
+    net = MultiLayerNetwork(conf).init()
+    listener = PlotFiltersIterationListener(net, str(tmp_path), every=1)
+    net.add_listener(listener)
+    rng = np.random.default_rng(0)
+    x = rng.random((16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    net.fit_batch(x, y)
+    import os
+    assert any(f.startswith("filters_") for f in os.listdir(tmp_path))

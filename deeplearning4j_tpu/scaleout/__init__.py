@@ -1,0 +1,62 @@
+"""Distributed training control plane.
+
+Parity: reference "scaleout" tier (SURVEY §2.3). The reference has four
+runtimes (Akka+Hazelcast, Spark, YARN/Avro, Zookeeper-provisioned) that all
+move full dense parameter vectors through a central master. In the TPU-native
+design the DATA PLANE — gradient exchange — is gone from here entirely: it is
+`lax.pmean` over ICI inside the jitted step (`parallel/data_parallel.py`).
+What remains, and what this package provides, is the CONTROL PLANE the
+reference built on Hazelcast IMaps + actors:
+
+- job queue / routing          (`WorkRouter`, reference workrouter/*)
+- worker registry + heartbeats (`StateTracker`, reference statetracker/*)
+- stale-worker reaping         (reference MasterActor.java:141-160, ≥120s)
+- update aggregation           (`JobAggregator`, reference INDArrayAggregator)
+- work persistence / elastic rejoin (reference LocalWorkRetriever/
+  LocalFileUpdateSaver)
+- periodic model saving        (reference ModelSavingActor)
+
+An in-process simulator (`DistributedRunner.simulate`) mirrors the
+reference's three "distributed without a cluster" test backends (SURVEY §4):
+master + N workers as threads against one tracker. For real multi-host TPU
+pods the same `StateTracker` API is served over TCP (tracker_server.py) on
+the coordinator host — DCN traffic is control messages only, parameters ride
+ICI collectives.
+"""
+
+from deeplearning4j_tpu.scaleout.api import (
+    Job,
+    JobAggregator,
+    JobIterator,
+    WorkerPerformer,
+    WorkRouter,
+)
+from deeplearning4j_tpu.scaleout.statetracker import StateTracker
+from deeplearning4j_tpu.scaleout.tracker_server import (
+    RemoteStateTracker,
+    StateTrackerServer,
+)
+from deeplearning4j_tpu.scaleout.aggregators import (
+    DeltaSumAggregator,
+    ParameterAveragingAggregator,
+)
+from deeplearning4j_tpu.scaleout.performers import (
+    NetworkPerformer,
+    Word2VecPerformer,
+)
+from deeplearning4j_tpu.scaleout.runner import (
+    DistributedRunner,
+    HogwildWorkRouter,
+    IterativeReduceWorkRouter,
+    Master,
+    Worker,
+)
+
+__all__ = [
+    "Job", "JobIterator", "WorkerPerformer", "JobAggregator", "WorkRouter",
+    "StateTracker", "RemoteStateTracker", "StateTrackerServer",
+    "ParameterAveragingAggregator", "DeltaSumAggregator",
+    "NetworkPerformer", "Word2VecPerformer",
+    "Master", "Worker", "DistributedRunner",
+    "IterativeReduceWorkRouter", "HogwildWorkRouter",
+]

@@ -1,0 +1,65 @@
+"""Update aggregators.
+
+Parity: reference `scaleout/aggregator/INDArrayAggregator.java` (sum then
+divide — parameter averaging) and the delta-folding the Spark word2vec
+driver does with `Word2VecChange` (SURVEY §3.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.scaleout.api import JobAggregator
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(lambda x, y: np.asarray(x) + np.asarray(y),
+                                  a, b)
+
+
+class ParameterAveragingAggregator(JobAggregator):
+    """Mean over worker parameter pytrees — the "iterative reduce" master
+    computation, identical math to `MultiLayerNetwork.merge()`."""
+
+    def __init__(self):
+        self._sum: Any = None
+        self._count = 0
+
+    def accumulate(self, result: Any) -> None:
+        self._sum = result if self._sum is None else _tree_add(
+            self._sum, result)
+        self._count += 1
+
+    def aggregate(self) -> Any:
+        if self._count == 0:
+            return None
+        return jax.tree_util.tree_map(
+            lambda s: np.asarray(s) / self._count, self._sum)
+
+    def reset(self) -> None:
+        self._sum, self._count = None, 0
+
+
+class DeltaSumAggregator(JobAggregator):
+    """Sum of sparse/dense deltas (distributed word2vec/glove: every worker's
+    embedding delta is applied, not averaged)."""
+
+    def __init__(self):
+        self._deltas: List[Any] = []
+
+    def accumulate(self, result: Any) -> None:
+        self._deltas.append(result)
+
+    def aggregate(self) -> Any:
+        if not self._deltas:
+            return None
+        total = self._deltas[0]
+        for d in self._deltas[1:]:
+            total = _tree_add(total, d)
+        return total
+
+    def reset(self) -> None:
+        self._deltas = []

@@ -1,0 +1,79 @@
+"""Worker performers: what a worker does with a job.
+
+Parity: reference `NeuralNetWorkPerformer` (Akka runtime: build net from
+conf JSON, set master params, fit job's DataSet, emit params — same contract
+as Spark's `IterativeReduceFlatMap.java:61-81`) and
+`scaleout/perform/models/word2vec/Word2VecPerformer.java:50` (train a
+sentence batch, emit embedding deltas).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.scaleout.api import Job, WorkerPerformer
+
+
+class NetworkPerformer(WorkerPerformer):
+    """Trains a MultiLayerNetwork replica on the job's (x, y) batch.
+
+    Ships the model as (conf-JSON, params) exactly like the reference's
+    universal format (`MultiLayerNetwork.java:97-101`): every worker
+    constructs its replica from JSON, installs the master's params in
+    `update()`, fits, and returns its params for averaging.
+    """
+
+    def __init__(self, conf_json: str, epochs: int = 1):
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+
+        self.net = MultiLayerNetwork.from_json(conf_json).init()
+        self.epochs = epochs
+
+    def perform(self, job: Job) -> None:
+        x, y = job.work
+        for _ in range(self.epochs):
+            self.net.fit_batch(np.asarray(x), np.asarray(y))
+        job.result = self.net.params
+        job.done = True
+
+    def update(self, state: Any) -> None:
+        if state is not None:
+            self.net.params = state
+
+
+class Word2VecPerformer(WorkerPerformer):
+    """Trains a Word2Vec replica on a batch of sentences; the result is the
+    (syn0, out) DELTA vs the round's starting weights, so the master can
+    fold every worker's contribution (DeltaSumAggregator) — the reference's
+    Word2VecChange collection (SURVEY §3.4)."""
+
+    def __init__(self, word2vec):
+        self.w2v = word2vec
+        if self.w2v.syn0 is None or not len(self.w2v.syn0):
+            raise ValueError("word2vec must have built vocab + weights")
+
+    def perform(self, job: Job) -> None:
+        w2v = self.w2v
+        start_syn0 = w2v.syn0.copy()
+        out_name = "syn1" if w2v.negative == 0 else "syn1neg"
+        start_out = getattr(w2v, out_name).copy()
+        w2v.fit(job.work)
+        job.result = {
+            "syn0": w2v.syn0 - start_syn0,
+            out_name: getattr(w2v, out_name) - start_out,
+        }
+        # restore: deltas are applied by the master's aggregate broadcast
+        w2v.syn0 = start_syn0
+        setattr(w2v, out_name, start_out)
+        job.done = True
+
+    def update(self, state: Optional[dict]) -> None:
+        if not state:
+            return
+        w2v = self.w2v
+        w2v.syn0 = w2v.syn0 + state["syn0"]
+        out_name = "syn1" if w2v.negative == 0 else "syn1neg"
+        setattr(w2v, out_name, getattr(w2v, out_name) + state[out_name])
+        w2v._norms = None

@@ -1,0 +1,131 @@
+"""StateTracker served over TCP for multi-host jobs.
+
+Parity: the reference's Hazelcast instance embedded in the master JVM
+(`BaseHazelCastStateTracker.java:520` — master embeds, workers connect) and
+its Dropwizard REST monitor. Here the coordinator host runs
+`StateTrackerServer` wrapping a local `StateTracker`; worker hosts talk to
+it through `RemoteStateTracker`, which proxies the same method surface, so
+`Master`/`Worker` run unchanged in-process (threads) or across hosts (DCN).
+Only control-plane messages cross this socket — gradient/parameter traffic
+stays on ICI collectives inside the jitted step.
+
+Framing: 4-byte big-endian length + pickle. Like the reference's Java
+serialization over Hazelcast, this assumes a trusted cluster network.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Optional, Tuple
+
+from deeplearning4j_tpu.scaleout.statetracker import StateTracker
+
+_ALLOWED = {
+    "add_worker", "remove_worker", "workers", "heartbeat", "heartbeats",
+    "reap_stale", "enqueue_job", "request_job", "current_jobs",
+    "pending_jobs", "clear_job", "add_update", "updates", "drain_updates",
+    "clear_updates",
+    "set_global", "get_global", "increment", "counter", "finish", "is_done",
+    "saved_work", "load_saved_work",
+}
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, 4)
+    (length,) = struct.unpack(">I", header)
+    return pickle.loads(_recv_exact(sock, length))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("tracker connection closed")
+        buf += chunk
+    return buf
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        tracker: StateTracker = self.server.tracker  # type: ignore[attr-defined]
+        while True:
+            try:
+                method, args, kwargs = _recv_frame(self.request)
+            except (ConnectionError, EOFError):
+                return
+            try:
+                if method not in _ALLOWED:
+                    raise AttributeError(f"no tracker method {method!r}")
+                result = getattr(tracker, method)(*args, **kwargs)
+                _send_frame(self.request, ("ok", result))
+            except Exception as e:  # noqa: BLE001 — proxy the error across
+                _send_frame(self.request, ("err", repr(e)))
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class StateTrackerServer:
+    """Embed a tracker and serve it (master side)."""
+
+    def __init__(self, tracker: Optional[StateTracker] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.tracker = tracker or StateTracker()
+        self._server = _Server((host, port), _Handler)
+        self._server.tracker = self.tracker  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address  # type: ignore[return-value]
+
+    def start(self) -> "StateTrackerServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RemoteStateTracker:
+    """Client proxy with the StateTracker method surface (worker side)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._lock = threading.Lock()
+
+    def _call(self, method: str, *args, **kwargs) -> Any:
+        with self._lock:
+            _send_frame(self._sock, (method, args, kwargs))
+            status, payload = _recv_frame(self._sock)
+        if status == "err":
+            raise RuntimeError(f"tracker error: {payload}")
+        return payload
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in _ALLOWED:
+            raise AttributeError(f"no tracker method {name!r}")
+
+        def proxy(*args, **kwargs):
+            return self._call(name, *args, **kwargs)
+
+        return proxy

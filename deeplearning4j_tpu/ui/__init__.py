@@ -1,0 +1,15 @@
+"""Training/embedding visualization server.
+
+Parity: reference `deeplearning4j-ui` — a Dropwizard (Jetty+Jersey) app
+(`UiServer.java:58,75`) with resources for coords upload (`ApiResource`),
+t-SNE (`TsneResource`), nearest neighbors over a VPTree
+(`NearestNeighborsResource.java`), weight/gradient histograms posted by a
+training listener (`HistogramIterationListener.java:61` →
+`WeightResource`), and activation renders (`ActivationsResource`). Here the
+server is a stdlib ThreadingHTTPServer exposing the same surfaces as JSON.
+"""
+
+from deeplearning4j_tpu.ui.server import UiServer
+from deeplearning4j_tpu.ui.listeners import HistogramIterationListener
+
+__all__ = ["UiServer", "HistogramIterationListener"]

@@ -1,0 +1,68 @@
+"""Training → UI listeners.
+
+Parity: reference `ui/weights/HistogramIterationListener.java:61` — fires
+per iteration, POSTs a ModelAndGradient JSON (weight/gradient summaries +
+score) to the UI server's /weights endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Optional
+
+import numpy as np
+
+
+def _summaries(tree) -> dict:
+    out = {}
+
+    def rec(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                rec(f"{prefix}/{k}" if prefix else str(k), v)
+        elif isinstance(node, (list, tuple)):
+            for k, v in enumerate(node):
+                rec(f"{prefix}/{k}" if prefix else str(k), v)
+        else:
+            arr = np.asarray(node)
+            hist, edges = np.histogram(arr.ravel(), bins=20)
+            out[prefix] = {
+                "mean": float(arr.mean()),
+                "std": float(arr.std()),
+                "min": float(arr.min()),
+                "max": float(arr.max()),
+                "hist": hist.tolist(),
+                "edges": edges.tolist(),
+            }
+
+    rec("", tree)
+    return out
+
+
+class HistogramIterationListener:
+    """POST weight summaries + score to the UI server every N iterations."""
+
+    def __init__(self, net, url: str, every: int = 1,
+                 timeout: float = 5.0):
+        self.net = net
+        self.url = url.rstrip("/") + "/weights"
+        self.every = max(1, every)
+        self.timeout = timeout
+        self.failures = 0
+
+    def __call__(self, iteration: int, score: float) -> None:
+        if iteration % self.every:
+            return
+        payload = {
+            "iteration": iteration,
+            "score": float(score),
+            "weights": _summaries(self.net.params),
+        }
+        req = urllib.request.Request(
+            self.url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=self.timeout).close()
+        except OSError:
+            self.failures += 1  # UI down must never kill training

@@ -1,0 +1,194 @@
+"""UI REST server (stdlib http.server).
+
+Endpoint parity with `UiServer.run():75-87`:
+
+- POST /api/coords            upload 2-D coords            (ApiResource.java)
+- GET  /api/coords            fetch them
+- POST /tsne/upload           upload high-dim vectors + labels
+- POST /tsne/generate         run t-SNE on the upload      (TsneResource)
+- GET  /tsne/coords           fetch generated coords
+- POST /nearestneighbors/upload   upload labelled vectors
+- POST /nearestneighbors          {"word"|"vector", "k"} → knn via VPTree
+                                  (NearestNeighborsResource.java:177)
+- POST /weights               training listener posts model-and-gradient
+                              histograms (HistogramIterationListener)
+- GET  /weights               latest + history summary     (WeightResource)
+- GET  /activations           activation grid as nested lists
+- POST /activations           upload an activation grid    (ActivationsResource)
+
+All payloads are JSON. `port=0` picks a free port (tests).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class _UiState:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.coords: List[List[float]] = []
+        self.tsne_vectors: Optional[np.ndarray] = None
+        self.tsne_labels: List[str] = []
+        self.tsne_coords: List[List[float]] = []
+        self.nn_vectors: Optional[np.ndarray] = None
+        self.nn_labels: List[str] = []
+        self.nn_tree = None
+        self.weights_history: List[dict] = []
+        self.activations: Optional[List] = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # silence per-request stderr logging
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    @property
+    def state(self) -> _UiState:
+        return self.server.ui_state  # type: ignore[attr-defined]
+
+    def _json(self, code: int, payload: Any) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _body(self) -> Any:
+        length = int(self.headers.get("Content-Length", 0))
+        if not length:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    # ---- GET --------------------------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802
+        s = self.state
+        with s.lock:
+            if self.path == "/api/coords":
+                self._json(200, {"coords": s.coords})
+            elif self.path == "/tsne/coords":
+                self._json(200, {"coords": s.tsne_coords,
+                                 "labels": s.tsne_labels})
+            elif self.path == "/weights":
+                self._json(200, {
+                    "count": len(s.weights_history),
+                    "last": s.weights_history[-1] if s.weights_history
+                    else None})
+            elif self.path == "/activations":
+                self._json(200, {"activations": s.activations})
+            else:
+                self._json(404, {"error": f"unknown path {self.path}"})
+
+    # ---- POST -------------------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802
+        try:
+            body = self._body()
+        except (ValueError, json.JSONDecodeError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        try:
+            self._route_post(body)
+        except Exception as e:  # noqa: BLE001 — surface as 400, keep serving
+            self._json(400, {"error": repr(e)})
+
+    def _route_post(self, body: Any) -> None:
+        s = self.state
+        if self.path == "/api/coords":
+            with s.lock:
+                s.coords = body["coords"]
+            self._json(200, {"count": len(s.coords)})
+        elif self.path == "/tsne/upload":
+            with s.lock:
+                s.tsne_vectors = np.asarray(body["vectors"], np.float32)
+                s.tsne_labels = body.get("labels",
+                                         [str(i) for i in
+                                          range(len(s.tsne_vectors))])
+            self._json(200, {"count": len(s.tsne_vectors)})
+        elif self.path == "/tsne/generate":
+            from deeplearning4j_tpu.plot import Tsne
+
+            with s.lock:
+                vectors = s.tsne_vectors
+            if vectors is None:
+                self._json(400, {"error": "upload vectors first"})
+                return
+            tsne = Tsne(
+                perplexity=float(body.get("perplexity", 30.0)),
+                n_iter=int(body.get("iterations", 300)),
+                learning_rate=float(body.get("learning_rate", 100.0)))
+            coords = tsne.calculate(vectors).tolist()
+            with s.lock:
+                s.tsne_coords = coords
+            self._json(200, {"coords": coords, "labels": s.tsne_labels})
+        elif self.path == "/nearestneighbors/upload":
+            from deeplearning4j_tpu.clustering import VPTree
+
+            with s.lock:
+                s.nn_vectors = np.asarray(body["vectors"], np.float32)
+                s.nn_labels = body.get(
+                    "labels", [str(i) for i in range(len(s.nn_vectors))])
+                s.nn_tree = VPTree(s.nn_vectors, labels=s.nn_labels,
+                                   distance=body.get("distance", "euclidean"))
+            self._json(200, {"count": len(s.nn_vectors)})
+        elif self.path == "/nearestneighbors":
+            with s.lock:
+                tree, labels, vectors = s.nn_tree, s.nn_labels, s.nn_vectors
+            if tree is None:
+                self._json(400, {"error": "upload vectors first"})
+                return
+            k = int(body.get("k", 5))
+            if "word" in body:
+                if body["word"] not in labels:
+                    self._json(404, {"error": f"unknown word {body['word']}"})
+                    return
+                query = vectors[labels.index(body["word"])]
+            else:
+                query = np.asarray(body["vector"], np.float32)
+            hits = tree.knn(query, k)
+            self._json(200, {"neighbors": [
+                {"label": lbl, "distance": float(d)} for d, lbl in hits]})
+        elif self.path == "/weights":
+            with s.lock:
+                s.weights_history.append(body)
+                if len(s.weights_history) > 1000:
+                    s.weights_history = s.weights_history[-1000:]
+            self._json(200, {"count": len(s.weights_history)})
+        elif self.path == "/activations":
+            with s.lock:
+                s.activations = body["activations"]
+            self._json(200, {"ok": True})
+        else:
+            self._json(404, {"error": f"unknown path {self.path}"})
+
+
+class UiServer:
+    """`UiServer(port=0).start()`; `.url` for clients; `.stop()` to halt."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8080):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.ui_state = _UiState()  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    @property
+    def state(self) -> _UiState:
+        return self._server.ui_state  # type: ignore[attr-defined]
+
+    def start(self) -> "UiServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,135 @@
+"""UI server tests (reference: ui/ApiTest, TestRenders boot the Dropwizard
+app via BaseUiServerTest; here the stdlib server boots on an OS-chosen
+port)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui import HistogramIterationListener, UiServer
+
+
+@pytest.fixture
+def server():
+    s = UiServer(port=0).start()
+    yield s
+    s.stop()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_coords_roundtrip(server):
+    coords = [[1.0, 2.0], [3.0, 4.0]]
+    assert _post(server.url + "/api/coords", {"coords": coords})["count"] == 2
+    assert _get(server.url + "/api/coords")["coords"] == coords
+
+
+def test_tsne_generate(server):
+    rng = np.random.default_rng(0)
+    vecs = np.concatenate([rng.normal(0, .3, (10, 8)),
+                           rng.normal(6, .3, (10, 8))]).tolist()
+    labels = [f"w{i}" for i in range(20)]
+    _post(server.url + "/tsne/upload", {"vectors": vecs, "labels": labels})
+    out = _post(server.url + "/tsne/generate",
+                {"perplexity": 5.0, "iterations": 60})
+    assert len(out["coords"]) == 20
+    assert out["labels"] == labels
+    assert _get(server.url + "/tsne/coords")["coords"] == out["coords"]
+
+
+def test_nearest_neighbors_by_word_and_vector(server):
+    vecs = [[0.0, 0.0], [0.1, 0.0], [5.0, 5.0]]
+    labels = ["origin", "near", "far"]
+    _post(server.url + "/nearestneighbors/upload",
+          {"vectors": vecs, "labels": labels})
+    out = _post(server.url + "/nearestneighbors", {"word": "origin", "k": 2})
+    assert [n["label"] for n in out["neighbors"]] == ["origin", "near"]
+    out = _post(server.url + "/nearestneighbors",
+                {"vector": [4.9, 5.1], "k": 1})
+    assert out["neighbors"][0]["label"] == "far"
+
+
+def test_nearest_neighbors_unknown_word_404(server):
+    _post(server.url + "/nearestneighbors/upload",
+          {"vectors": [[0.0, 1.0]], "labels": ["a"]})
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(server.url + "/nearestneighbors", {"word": "nope"})
+    assert exc.value.code == 404
+
+
+def test_weights_endpoint_and_listener(server):
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayerConf,
+        MultiLayerConfiguration,
+        NeuralNetConfiguration,
+        OutputLayerConf,
+    )
+
+    conf = MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=0.1),
+        layers=(DenseLayerConf(n_in=4, n_out=8),
+                OutputLayerConf(n_in=8, n_out=3)))
+    net = MultiLayerNetwork(conf).init()
+    net.add_listener(HistogramIterationListener(net, server.url, every=1))
+    rng = np.random.default_rng(0)
+    x = rng.random((16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    net.fit_batch(x, y)
+    net.fit_batch(x, y)
+
+    out = _get(server.url + "/weights")
+    assert out["count"] == 2
+    last = out["last"]
+    assert "score" in last
+    any_summary = next(iter(last["weights"].values()))
+    assert set(any_summary) >= {"mean", "std", "hist"}
+
+
+def test_listener_survives_dead_server():
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayerConf,
+        MultiLayerConfiguration,
+        NeuralNetConfiguration,
+        OutputLayerConf,
+    )
+
+    conf = MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=0.1),
+        layers=(DenseLayerConf(n_in=4, n_out=8),
+                OutputLayerConf(n_in=8, n_out=3)))
+    net = MultiLayerNetwork(conf).init()
+    listener = HistogramIterationListener(
+        net, "http://127.0.0.1:9", every=1, timeout=0.2)
+    net.add_listener(listener)
+    rng = np.random.default_rng(0)
+    x = rng.random((8, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    net.fit_batch(x, y)  # must not raise
+    assert listener.failures == 1
+
+
+def test_activations_roundtrip(server):
+    grid = [[0.0, 1.0], [1.0, 0.0]]
+    _post(server.url + "/activations", {"activations": grid})
+    assert _get(server.url + "/activations")["activations"] == grid
+
+
+def test_unknown_path_404(server):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(server.url + "/nope")
+    assert exc.value.code == 404

@@ -1,0 +1,191 @@
+"""Hybrid-parallel training: dp x sp x tp (+ ep) and dp x pp meshes.
+
+This is the "scale shape" of the TPU framework (scaling-book recipe: pick a
+mesh, annotate shardings, let XLA insert collectives):
+
+- `HybridParallelTrainer`: TransformerLM over mesh axes (data, seq, model).
+  Batch shards over `data` (dp), sequence over `seq` with ring attention
+  (sp/CP), heads/hidden/experts over `model` (tp + ep). dp/tp/ep are GSPMD
+  — parameters placed by `param_specs`, activations constrained, `jax.grad`
+  taken over the full-array program so XLA derives the backward collectives.
+  Only the ring-attention inner loop is shard_map (see transformer.py).
+- `PipelineParallelTrainer`: mesh (data, stage) — transformer blocks
+  stacked and sharded over `stage` (pp), GPipe microbatching via
+  scan+ppermute (`pipeline.py`) under shard_map. The loss is computed on
+  the last stage, masked elsewhere, and psum'd; with shard_map's
+  psum-transposes-to-psum semantics (check_rep=False) every gradient then
+  carries a uniform n_stages factor, removed by one normalization, and
+  io-param gradients (stage-partial by construction) are psum'd across
+  stages. A test asserts step-for-step equality with the single-device
+  model for both trainers.
+
+Both run unchanged on a v5e-8 or the 8-device virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel import transformer as tfm
+from deeplearning4j_tpu.parallel.data_parallel import shard_map
+from deeplearning4j_tpu.parallel.pipeline import gpipe_apply
+
+
+def _sgd_tree(params, grads, lr):
+    return jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+
+
+def place_params(mesh: Mesh, tree, spec_tree):
+    """device_put a pytree with a matching pytree of PartitionSpecs
+    (PartitionSpec is itself a tuple, so flatten the spec tree with specs
+    as leaves rather than tree_map-ing the two trees together)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    specs = jax.tree_util.tree_flatten(
+        spec_tree, is_leaf=lambda x: isinstance(x, P))[0]
+    assert len(leaves) == len(specs), (len(leaves), len(specs))
+    placed = [jax.device_put(a, NamedSharding(mesh, s))
+              for a, s in zip(leaves, specs)]
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
+class HybridParallelTrainer:
+    """dp x sp x tp(+ep) training for the TransformerLM via GSPMD."""
+
+    def __init__(self, cfg: tfm.TransformerConfig, mesh: Mesh,
+                 lr: float = 1e-2, seed: int = 0,
+                 axes: tfm.MeshAxes = tfm.MeshAxes()):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.lr = lr
+        self.axes = axes
+        self._pspecs = tfm.param_specs(cfg, axes.model)
+        self.params = place_params(
+            mesh, tfm.init_params(cfg, jax.random.PRNGKey(seed)),
+            self._pspecs)
+        cfg_, lr_, mesh_, axes_ = cfg, lr, mesh, axes
+
+        def step(params, tokens, targets):
+            loss, grads = jax.value_and_grad(
+                lambda p: tfm.lm_loss(cfg_, p, tokens, targets, mesh_,
+                                      axes_))(params)
+            return _sgd_tree(params, grads, lr_), loss
+
+        self._step = jax.jit(step, donate_argnums=(0,))
+
+    def fit_batch(self, tokens, targets) -> float:
+        dsh = NamedSharding(self.mesh, P(self.axes.data, self.axes.seq))
+        tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), dsh)
+        targets = jax.device_put(jnp.asarray(targets, jnp.int32), dsh)
+        self.params, loss = self._step(self.params, tokens, targets)
+        return float(loss)
+
+
+class PipelineParallelTrainer:
+    """dp x pp training: transformer blocks sharded over `stage`."""
+
+    def __init__(self, cfg: tfm.TransformerConfig, mesh: Mesh,
+                 n_microbatches: int = 4, lr: float = 1e-2, seed: int = 0,
+                 data_axis: str = "data", stage_axis: str = "stage"):
+        if cfg.n_experts:
+            raise ValueError("pipeline demo uses dense MLP blocks")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.lr = lr
+        self.m = n_microbatches
+        self.axes = (data_axis, stage_axis)
+        n_stages = dict(zip(mesh.axis_names, mesh.devices.shape))[stage_axis]
+        if cfg.n_layers % n_stages:
+            raise ValueError(
+                f"n_layers {cfg.n_layers} must divide into {n_stages} stages")
+        self.layers_per_stage = cfg.n_layers // n_stages
+        self.n_stages = n_stages
+
+        full = tfm.init_params(cfg, jax.random.PRNGKey(seed))
+        # stack per-layer trees: leaves [n_layers, ...] regrouped to
+        # [n_stages, layers_per_stage, ...]; stage dim sharded over `stage`.
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves).reshape(
+                (n_stages, self.layers_per_stage) + leaves[0].shape),
+            *full["layers"])
+        self.stage_params = jax.tree_util.tree_map(
+            lambda a: jax.device_put(
+                a, NamedSharding(mesh, P(stage_axis))), stacked)
+        self.io_params = jax.device_put(
+            {"embed": full["embed"], "pos": full["pos"],
+             "ln_f": full["ln_f"], "head": full["head"]},
+            NamedSharding(mesh, P()))
+        self._step = self._build_step()
+
+    def _stage_fn(self, stage_params, x):
+        """Apply this stage's block(s); activation shape preserved."""
+        for i in range(self.layers_per_stage):
+            layer = jax.tree_util.tree_map(lambda a: a[i], stage_params)
+            x = x + tfm._attn(layer["attn"],
+                              tfm._layer_norm(layer["ln1"], x),
+                              None, tfm.MeshAxes(), True)
+            x = x + tfm._mlp(layer["mlp"],
+                             tfm._layer_norm(layer["ln2"], x))
+        return x
+
+    def _build_step(self):
+        lr, m = self.lr, self.m
+        data_axis, stage_axis = self.axes
+        stage_fn = self._stage_fn
+
+        def step(stage_params, io_params, tokens, targets):
+            n_stages = lax.psum(1, stage_axis)
+            is_last = lax.axis_index(stage_axis) == n_stages - 1
+
+            def loss_fn(sp, iop):
+                x = iop["embed"][tokens]
+                s = tokens.shape[1]
+                x = x + iop["pos"][None, :s, :]
+                mb = x.reshape((m, x.shape[0] // m) + x.shape[1:])
+                y = gpipe_apply(stage_fn, sp, mb, stage_axis)
+                y = y.reshape(x.shape)
+                y = tfm._layer_norm(iop["ln_f"], y)
+                logits = jnp.einsum("bsd,dv->bsv", y, iop["head"])
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, targets[..., None], axis=-1)[..., 0]
+                # loss lives on the LAST stage; the psum replicates its
+                # value AND (via psum-transposes-to-psum) scales every
+                # gradient by exactly n_stages — normalized below.
+                local = jnp.where(is_last, jnp.mean(nll), 0.0)
+                return lax.psum(local, stage_axis)
+
+            loss, (g_stage, g_io) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(stage_params, io_params)
+            inv = 1.0 / n_stages
+            # stage params: per-shard grads are n_stages x own-slice grad.
+            g_stage = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g * inv, data_axis), g_stage)
+            # io params: stage-partial (embed/pos accumulate on stage 0,
+            # ln_f/head on the last stage) -> sum across stages, then
+            # remove the same n_stages factor.
+            g_io = jax.tree_util.tree_map(
+                lambda g: lax.pmean(lax.psum(g, stage_axis) * inv,
+                                    data_axis), g_io)
+            loss = lax.pmean(loss, data_axis)
+            return (_sgd_tree(stage_params, g_stage, lr),
+                    _sgd_tree(io_params, g_io, lr), loss)
+
+        fn = shard_map(
+            step, mesh=self.mesh,
+            in_specs=(P(stage_axis), P(), P(data_axis), P(data_axis)),
+            out_specs=(P(stage_axis), P(), P()),
+            check_rep=False)
+        return jax.jit(fn, donate_argnums=(0, 1))
+
+    def fit_batch(self, tokens, targets) -> float:
+        dsh = NamedSharding(self.mesh, P(self.axes[0]))
+        tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), dsh)
+        targets = jax.device_put(jnp.asarray(targets, jnp.int32), dsh)
+        self.stage_params, self.io_params, loss = self._step(
+            self.stage_params, self.io_params, tokens, targets)
+        return float(loss)

@@ -1,0 +1,70 @@
+"""Pipeline parallelism: layers sharded over a `stage` mesh axis.
+
+Out-of-reference extension (nothing in the 2015 reference pipelines layers
+across devices — SURVEY §2.3 item 3). GPipe-style schedule expressed the
+TPU way: stage parameters are STACKED on a leading dim sharded over the
+`stage` axis, every device runs the same shard_map program, and activations
+hop stage→stage with `lax.ppermute` inside a `lax.scan` over
+M + P - 1 ticks. The whole schedule — bubbles and all — is one compiled
+XLA program; `jax.grad` differentiates straight through the scan+ppermute
+for the backward pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe_apply(stage_fn: Callable, stage_params, x_microbatches: jax.Array,
+                axis_name: str) -> jax.Array:
+    """Run microbatches through the stage pipeline.
+
+    stage_fn(local_params, x) -> y, same activation shape in and out.
+    stage_params: LOCAL stage's params (leading stage dim already consumed
+    by shard_map's in_spec, i.e. leaves are [1, ...]; indexed [0] here).
+    x_microbatches: [M, mb, ...] — every stage sees all microbatches
+    (replicated); only stage 0 consumes them.
+    Returns [M, mb, ...] outputs (valid on the LAST stage; other stages
+    return zeros — callers typically psum or select).
+    """
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    local_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    act_shape = x_microbatches.shape[1:]
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # stage 0 injects microbatch t (clamped; validity handled below)
+        mb = lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+        x_in = jnp.where(stage == 0, mb, incoming)
+        y = stage_fn(local_params, x_in)
+        # last stage banks its result for ticks where it holds microbatch
+        # t - (n_stages - 1)
+        out_idx = t - (n_stages - 1)
+        valid = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+        outputs = lax.cond(
+            valid,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.clip(out_idx, 0, m - 1), axis=0),
+            lambda o: o,
+            outputs)
+        nxt = lax.ppermute(y, axis_name, perm)
+        return (nxt, outputs), None
+
+    init = (jnp.zeros(act_shape, x_microbatches.dtype),
+            jnp.zeros((m,) + act_shape, x_microbatches.dtype))
+    (_, outputs), _ = lax.scan(
+        tick, init, jnp.arange(m + n_stages - 1))
+    # broadcast the last stage's outputs to every stage so downstream code
+    # (loss) is uniform SPMD
+    last = lax.psum(
+        jnp.where(stage == n_stages - 1, 1.0, 0.0) * outputs, axis_name)
+    return last

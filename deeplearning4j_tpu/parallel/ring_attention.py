@@ -1,0 +1,107 @@
+"""Ring attention: exact attention over a sequence sharded across devices.
+
+Out-of-reference extension (SURVEY §5 "long-context: absent" — the 2015
+reference loops an LSTM over time on one device, `GravesLSTM.java:108`).
+For the TPU framework long context is first-class: the sequence dimension
+is sharded over a mesh axis, each device holds a Q/K/V block, and K/V
+blocks rotate around the ring via `lax.ppermute` while a running
+flash-attention-style (m, l, o) accumulator keeps the softmax exact —
+O(S/P) memory per device, compute overlapping communication on ICI.
+
+Pattern follows the public blockwise/ring attention formulation (Liu et al.
+ring attention; PAPERS.md) — no reference code involved.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask):
+    """One Q-block vs one KV-block. q:[B,Sq,H,D] k,v:[B,Sk,H,D]
+    mask:[Sq,Sk] bool (True = attend). Returns (scores-max m:[B,Sq,H],
+    sumexp l:[B,Sq,H], out o:[B,Sq,H,D])."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bqhk", q, k,
+                   precision=lax.Precision.HIGHEST) / jnp.sqrt(
+                       jnp.asarray(d, q.dtype))
+    s = jnp.where(mask[None, :, None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    # rows with no attendable key: exp(NEG_INF - NEG_INF) = 1 per key —
+    # mask them back out so l counts only real keys.
+    p = jnp.where(mask[None, :, None, :], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bqhk,bkhd->bqhd", p, v,
+                   precision=lax.Precision.HIGHEST)
+    return m, l, o
+
+
+def attention(q, k, v, causal: bool = True):
+    """Plain single-device attention [B,S,H,D] — the unsharded baseline."""
+    sq, sk = q.shape[1], k.shape[1]
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+    else:
+        mask = jnp.ones((sq, sk), bool)
+    m, l, o = _block_attn(q, k, v, mask)
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def ring_attention(q, k, v, axis_name: Optional[str], causal: bool = True):
+    """Attention with the S dimension sharded over `axis_name`.
+
+    Call inside shard_map: q/k/v are the LOCAL blocks [B, S_local, H, D].
+    Requires equal S_local per device. axis_name=None falls back to the
+    dense single-device path.
+    """
+    if axis_name is None:
+        return attention(q, k, v, causal)
+
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    b, _, h, dh = q.shape
+
+    # positions are global: block i covers [i*s_local, (i+1)*s_local)
+    q_pos = my_idx * s_local + jnp.arange(s_local)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def body(carry, _):
+        kv, kv_idx, m, l, o = carry
+        k_blk, v_blk = kv
+        k_pos = kv_idx * s_local + jnp.arange(s_local)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = jnp.ones((s_local, s_local), bool)
+        bm, bl, bo = _block_attn(q, k_blk, v_blk, mask)
+        new_m = jnp.maximum(m, bm)
+        # rescale both accumulators onto the new max
+        scale_old = jnp.exp(m - new_m)
+        scale_new = jnp.exp(bm - new_m)
+        l = l * scale_old + bl * scale_new
+        o = o * scale_old[..., None] + bo * scale_new[..., None]
+        # rotate KV around the ring (overlaps with next block's compute)
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        kv_idx = lax.ppermute(kv_idx, axis_name, perm)
+        return ((k_nxt, v_nxt), kv_idx, new_m, l, o), None
+
+    init = (
+        (k, v),
+        my_idx,
+        jnp.full((b, s_local, h), NEG_INF, q.dtype),
+        jnp.zeros((b, s_local, h), q.dtype),
+        jnp.zeros((b, s_local, h, dh), q.dtype),
+    )
+    (_, _, _, l, o), _ = lax.scan(body, init, None, length=axis_size)
+    return o / jnp.maximum(l, 1e-30)[..., None]

@@ -24,7 +24,7 @@ Both run unchanged on a v5e-8 or the 8-device virtual CPU mesh.
 
 from __future__ import annotations
 
-from typing import Optional
+
 
 import jax
 import jax.numpy as jnp
@@ -183,8 +183,16 @@ class PipelineParallelTrainer:
         return jax.jit(fn, donate_argnums=(0, 1))
 
     def fit_batch(self, tokens, targets) -> float:
+        tokens = jnp.asarray(tokens, jnp.int32)
+        n_data = dict(zip(self.mesh.axis_names,
+                          self.mesh.devices.shape))[self.axes[0]]
+        local = tokens.shape[0] // n_data
+        if tokens.shape[0] % n_data or local % self.m:
+            raise ValueError(
+                f"global batch {tokens.shape[0]} must split into "
+                f"{n_data} data shards x {self.m} microbatches")
         dsh = NamedSharding(self.mesh, P(self.axes[0]))
-        tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), dsh)
+        tokens = jax.device_put(tokens, dsh)
         targets = jax.device_put(jnp.asarray(targets, jnp.int32), dsh)
         self.stage_params, self.io_params, loss = self._step(
             self.stage_params, self.io_params, tokens, targets)

@@ -14,7 +14,7 @@ ring attention; PAPERS.md) — no reference code involved.
 
 from __future__ import annotations
 
-from functools import partial
+
 from typing import Optional
 
 import jax

@@ -25,7 +25,7 @@ model; tests assert step-for-step equivalence between the two.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

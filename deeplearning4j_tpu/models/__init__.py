@@ -1,3 +1,7 @@
 from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.models.recursive_autoencoder import (
+    RecursiveAutoEncoder,
+)
+from deeplearning4j_tpu.models.rntn import RNTN, RNTNEval
 
-__all__ = ["MultiLayerNetwork"]
+__all__ = ["MultiLayerNetwork", "RNTN", "RNTNEval", "RecursiveAutoEncoder"]

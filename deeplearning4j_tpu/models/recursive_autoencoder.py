@@ -1,0 +1,110 @@
+"""Recursive autoencoder over constituency trees.
+
+Parity: reference `nn/layers/feedforward/autoencoder/recursive/
+RecursiveAutoEncoder.java` — each internal node encodes its two children
+(c = tanh(We [a;b] + be)) and is trained to reconstruct them
+(([a';b'] = Wd c + bd), loss = ||[a;b] - [a';b']||^2 summed over internal
+nodes). Runs on the same padded post-order tree programs as the RNTN
+(nlp/tree.py) — one lax.scan per tree, vmapped, jax.grad for training.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tree import Tree, compile_trees
+
+
+class RecursiveAutoEncoder:
+    def __init__(self, d: int = 32, lr: float = 0.05, epochs: int = 50,
+                 seed: int = 0, max_nodes: Optional[int] = None):
+        self.d = d
+        self.lr = lr
+        self.epochs = epochs
+        self.seed = seed
+        self.max_nodes = max_nodes
+        self.vocab: Dict[str, int] = {"<unk>": 0}
+        self.params = None
+        self.losses: List[float] = []
+
+    def _init_params(self):
+        rng = np.random.default_rng(self.seed)
+        d = self.d
+
+        def r(*shape, scale):
+            return jnp.asarray(rng.standard_normal(shape) * scale,
+                               jnp.float32)
+
+        return {
+            "embed": r(len(self.vocab), d, scale=0.1),
+            "We": r(2 * d, d, scale=1.0 / np.sqrt(2 * d)),
+            "be": jnp.zeros((d,), jnp.float32),
+            "Wd": r(d, 2 * d, scale=1.0 / np.sqrt(d)),
+            "bd": jnp.zeros((2 * d,), jnp.float32),
+        }
+
+    @staticmethod
+    def _forward(params, is_leaf, word, left, right):
+        """One tree; returns (buffer [N,d], per-node reconstruction err)."""
+        n = is_leaf.shape[0]
+        d = params["embed"].shape[1]
+
+        def step(buf, t):
+            a, b = buf[left[t]], buf[right[t]]
+            ab = jnp.concatenate([a, b])
+            enc = jnp.tanh(params["We"].T @ ab + params["be"])
+            vec = jnp.where(is_leaf[t] == 1,
+                            jnp.tanh(params["embed"][word[t]]), enc)
+            recon = params["Wd"].T @ enc + params["bd"]
+            err = jnp.sum((recon - ab) ** 2) * (1 - is_leaf[t])
+            return buf.at[t].set(vec), err
+
+        buf0 = jnp.zeros((n, d), jnp.float32)
+        buf, errs = jax.lax.scan(step, buf0, jnp.arange(n))
+        return buf, errs
+
+    def fit(self, trees: Sequence[Tree]) -> "RecursiveAutoEncoder":
+        for t in trees:
+            for w in t.tokens():
+                self.vocab.setdefault(w, len(self.vocab))
+        prog = compile_trees(trees, self.vocab, self.max_nodes)
+        if self.params is None:
+            self.params = self._init_params()
+        arrays = tuple(jnp.asarray(a) for a in (
+            prog.is_leaf, prog.word, prog.left, prog.right, prog.mask))
+        lr = self.lr
+        forward = self._forward
+
+        def loss_fn(params, is_leaf, word, left, right, mask):
+            _, errs = jax.vmap(lambda il, w, l, r: forward(
+                params, il, w, l, r))(is_leaf, word, left, right)
+            return jnp.sum(errs * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        @jax.jit
+        def step(params, ada, *args):
+            loss, grads = jax.value_and_grad(loss_fn)(params, *args)
+            new_p, new_a = {}, {}
+            for k in params:
+                h = ada[k] + grads[k] * grads[k]
+                new_p[k] = params[k] - lr * grads[k] / jnp.sqrt(h + 1e-8)
+                new_a[k] = h
+            return new_p, new_a, loss
+
+        ada = {k: jnp.zeros_like(v) for k, v in self.params.items()}
+        self.losses = []
+        for _ in range(self.epochs):
+            self.params, ada, loss = step(self.params, ada, *arrays)
+            self.losses.append(float(loss))
+        return self
+
+    def encode(self, trees: Sequence[Tree]) -> np.ndarray:
+        """Root vector per tree [B, d] — the sentence embedding."""
+        prog = compile_trees(trees, self.vocab, self.max_nodes)
+        bufs, _ = jax.vmap(lambda il, w, l, r: self._forward(
+            self.params, il, w, l, r))(*(jnp.asarray(a) for a in (
+                prog.is_leaf, prog.word, prog.left, prog.right)))
+        return np.asarray(bufs)[np.arange(len(prog)), prog.root]

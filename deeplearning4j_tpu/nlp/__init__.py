@@ -41,6 +41,12 @@ from deeplearning4j_tpu.nlp.serde import (
     write_binary_model,
     write_word_vectors,
 )
+from deeplearning4j_tpu.nlp.tree import (
+    Tree,
+    compile_trees,
+    parse_ptb,
+    right_branching,
+)
 
 __all__ = [
     "DefaultTokenizer", "NGramTokenizer", "DefaultTokenizerFactory",
@@ -52,4 +58,5 @@ __all__ = [
     "CountVectorizer", "TfidfVectorizer",
     "write_word_vectors", "load_txt_vectors", "write_binary_model",
     "read_binary_model",
+    "Tree", "parse_ptb", "right_branching", "compile_trees",
 ]

@@ -1,0 +1,120 @@
+"""Tree / RNTN / recursive-autoencoder tests (reference: BasicRNTNTest,
+Tree.java tests; SURVEY §4). Uses a tiny synthetic sentiment grammar."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.rntn import RNTN, RNTNEval
+from deeplearning4j_tpu.models.recursive_autoencoder import (
+    RecursiveAutoEncoder,
+)
+from deeplearning4j_tpu.nlp.tree import (
+    Tree,
+    compile_trees,
+    parse_ptb,
+    right_branching,
+)
+
+
+class TestTree:
+    def test_parse_ptb_roundtrip_structure(self):
+        t = parse_ptb("(3 (2 good) (3 (2 not) (1 bad)))")
+        assert t.label == 3
+        assert t.tokens() == ["good", "not", "bad"]
+        assert len(t.nodes()) == 5
+        assert t.depth() == 2
+
+    def test_parse_rejects_trailing(self):
+        with pytest.raises(ValueError):
+            parse_ptb("(1 a) (2 b)")
+
+    def test_binarize_ternary(self):
+        t = parse_ptb("(1 (0 a) (0 b) (0 c))")
+        b = t.binarize()
+        assert all(len(n.children) in (0, 2) for n in b.nodes())
+        assert b.tokens() == ["a", "b", "c"]
+
+    def test_right_branching(self):
+        t = right_branching(["a", "b", "c", "d"])
+        assert t.tokens() == ["a", "b", "c", "d"]
+        assert all(len(n.children) in (0, 2) for n in t.nodes())
+
+    def test_compile_postorder_invariant(self):
+        t = parse_ptb("(3 (2 good) (1 bad))")
+        prog = compile_trees([t], {"good": 1, "bad": 2})
+        # children always appear before parents
+        for j in range(prog.n_nodes):
+            if prog.mask[0, j] and not prog.is_leaf[0, j]:
+                assert prog.left[0, j] < j
+                assert prog.right[0, j] < j
+        assert prog.root[0] == int(prog.mask[0].sum()) - 1
+
+    def test_compile_pads_to_common_length(self):
+        trees = [parse_ptb("(1 (0 a) (0 b))"),
+                 parse_ptb("(1 (0 a) (1 (0 b) (0 c)))")]
+        prog = compile_trees(trees, {"a": 1, "b": 2, "c": 3})
+        assert prog.is_leaf.shape == (2, 5)
+        assert prog.mask[0].sum() == 3
+        assert prog.mask[1].sum() == 5
+
+
+def _sentiment_corpus():
+    """Tiny grammar: 'good'-rooted trees labelled 1, 'bad' labelled 0,
+    with negation flipping the label."""
+    data = [
+        "(1 (1 good) (1 movie))",
+        "(1 (1 great) (1 film))",
+        "(0 (0 bad) (1 movie))",
+        "(0 (0 awful) (1 film))",
+        "(0 (0 not) (1 good))",
+        "(0 (0 not) (1 great))",
+        "(1 (0 not) (0 bad))",
+        "(1 (0 not) (0 awful))",
+        "(1 (1 (1 very) (1 good)) (1 movie))",
+        "(0 (0 (0 very) (0 bad)) (1 film))",
+    ]
+    return [parse_ptb(s) for s in data]
+
+
+class TestRNTN:
+    def test_learns_tiny_sentiment(self):
+        trees = _sentiment_corpus()
+        model = RNTN(num_classes=2, d=8, lr=0.1, epochs=150, seed=0)
+        model.fit(trees)
+        assert model.losses[-1] < model.losses[0]
+        ev = RNTNEval()
+        ev.eval(model, trees)
+        assert ev.root_accuracy() == 1.0, ev.stats()
+        assert ev.node_accuracy() > 0.8, ev.stats()
+
+    def test_predict_generalizes_structure(self):
+        trees = _sentiment_corpus()
+        model = RNTN(num_classes=2, d=8, lr=0.1, epochs=150, seed=0,
+                     max_nodes=16)
+        model.fit(trees)
+        test = [parse_ptb("(1 (1 good) (1 film))"),
+                parse_ptb("(0 (0 bad) (1 film))")]
+        preds = model.predict(test)
+        assert preds[0] == 1
+        assert preds[1] == 0
+
+    def test_predict_nodes_shapes(self):
+        trees = _sentiment_corpus()
+        model = RNTN(num_classes=2, d=4, lr=0.1, epochs=5)
+        model.fit(trees)
+        per_node = model.predict_nodes(trees[:2])
+        assert len(per_node) == 2
+        assert len(per_node[0]) == len(trees[0].binarize().nodes())
+
+
+class TestRecursiveAutoEncoder:
+    def test_reconstruction_improves_and_encodes(self):
+        trees = [right_branching(s.split()) for s in (
+            "the cat sat", "the dog ran", "a cat ran", "the dog sat",
+            "a dog sat on the mat", "the cat ran home")]
+        rae = RecursiveAutoEncoder(d=16, lr=0.05, epochs=60, seed=1)
+        rae.fit(trees)
+        assert rae.losses[-1] < rae.losses[0] * 0.9
+        vecs = rae.encode(trees)
+        assert vecs.shape == (6, 16)
+        assert np.all(np.isfinite(vecs))

@@ -3,10 +3,12 @@ from deeplearning4j_tpu.datasets.iterators import (
     ArrayDataSetIterator,
     DataSetIterator,
     MultipleEpochsIterator,
+    PrefetchDataSetIterator,
     SamplingDataSetIterator,
 )
 
 __all__ = [
     "DataSet", "DataSetIterator", "ArrayDataSetIterator",
     "MultipleEpochsIterator", "SamplingDataSetIterator",
+    "PrefetchDataSetIterator",
 ]

@@ -114,7 +114,20 @@ def _package_mnist(images: np.ndarray, labels: np.ndarray, binarize: bool,
 
 def csv_dataset(path: str, label_col: int = -1, num_classes: Optional[int] = None,
                 skip_header: bool = False, delimiter: str = ",") -> DataSet:
-    """CSV → DataSet (reference CSVDataSetIterator / Canova CSV reader)."""
+    """CSV → DataSet (reference CSVDataSetIterator / Canova CSV reader).
+    Parses through the native C++ loader when built (native/dataio.cpp);
+    numpy fallback otherwise."""
+    try:
+        from deeplearning4j_tpu import native
+
+        if native.have_native():
+            features, labels = native.csv_read(
+                path, skip_header=skip_header, label_col=label_col)
+            labels = labels.astype(int)
+            k = num_classes or int(labels.max()) + 1
+            return DataSet(features.astype(np.float32), one_hot(labels, k))
+    except (ValueError, RuntimeError):
+        pass  # fall through to the Python parser
     raw = np.genfromtxt(path, delimiter=delimiter,
                         skip_header=1 if skip_header else 0, dtype=np.float32)
     if raw.ndim == 1:
@@ -146,22 +159,36 @@ def sniff_svmlight_features(path: str) -> int:
 def svmlight_dataset(path: str, num_features: int,
                      num_classes: Optional[int] = None) -> DataSet:
     """SVMLight/libsvm format (reference CLI default input format,
-    Train.java:74)."""
-    rows, labels = [], []
-    with open(path) as f:
-        for line in f:
-            line = line.split("#")[0].strip()
-            if not line:
-                continue
-            parts = line.split()
-            labels.append(float(parts[0]))
-            vec = np.zeros(num_features, np.float32)
-            for tok in parts[1:]:
-                i, v = tok.split(":")
-                if not i.isdigit():  # skip qid:/cost: style meta tokens
+    Train.java:74). Native C++ parse when built; Python fallback."""
+    rows = None
+    try:
+        from deeplearning4j_tpu import native
+
+        if native.have_native():
+            feats, y_arr = native.svmlight_read(path, num_features)
+            if feats.shape[1] < num_features:  # tail columns all-zero
+                feats = np.pad(feats,
+                               ((0, 0), (0, num_features - feats.shape[1])))
+            rows = list(feats[:, :num_features].astype(np.float32))
+            labels = list(y_arr)
+    except (ValueError, RuntimeError):
+        rows = None
+    if rows is None:
+        rows, labels = [], []
+        with open(path) as f:
+            for line in f:
+                line = line.split("#")[0].strip()
+                if not line:
                     continue
-                vec[int(i) - 1] = float(v)  # svmlight is 1-indexed
-            rows.append(vec)
+                parts = line.split()
+                labels.append(float(parts[0]))
+                vec = np.zeros(num_features, np.float32)
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    if not i.isdigit():  # skip qid:/cost: meta tokens
+                        continue
+                    vec[int(i) - 1] = float(v)  # svmlight is 1-indexed
+                rows.append(vec)
     y = np.asarray(labels)
     y_int = y.astype(int)
     if np.all(y == y_int):

@@ -116,3 +116,57 @@ class SamplingDataSetIterator(DataSetIterator):
 
     def total_examples(self):
         return self.batch * self.num_batches
+
+
+class PrefetchDataSetIterator(DataSetIterator):
+    """Background-thread prefetch over any DataSetIterator.
+
+    The host pipeline half of HBM-bandwidth hygiene: batch b+1 is parsed/
+    staged while the device trains on batch b, so input IO never blocks the
+    TPU step. Plays the role of the reference's async fetcher/queue pattern
+    (BaseDataFetcher + DiskBasedQueue) with a bounded queue for backpressure.
+    """
+
+    _DONE = object()
+
+    def __init__(self, base: DataSetIterator, depth: int = 2):
+        import queue as _queue
+        import threading as _threading
+
+        self.base = base
+        self.depth = max(1, depth)
+        self._queue_mod = _queue
+        self._threading = _threading
+
+    def __iter__(self):
+        q = self._queue_mod.Queue(maxsize=self.depth)
+        errors = []
+
+        def producer():
+            try:
+                for item in self.base:
+                    q.put(item)
+            except Exception as e:  # noqa: BLE001 — re-raise on consumer side
+                errors.append(e)
+            finally:
+                q.put(self._DONE)
+
+        t = self._threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._DONE:
+                break
+            yield item
+        t.join()
+        if errors:
+            raise errors[0]
+
+    def reset(self):
+        self.base.reset()
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+    def total_examples(self):
+        return self.base.total_examples()

@@ -1,0 +1,131 @@
+"""ctypes bindings for the native data-IO library (dataio.cpp).
+
+Builds `_dataio.so` with g++ on first import (cached next to the source,
+rebuilt when the .cpp is newer). Everything degrades gracefully: when no
+compiler is available `HAVE_NATIVE` is False and the dataset fetchers fall
+back to their pure-Python parsers. No pybind11 — plain C ABI + ctypes per
+the environment constraints.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = pathlib.Path(__file__).resolve().parent
+_SRC = _DIR / "dataio.cpp"
+_LIB_PATH = _DIR / "_dataio.so"
+
+_lib = None
+BUILD_ERROR: Optional[str] = None
+
+
+class _Table(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.POINTER(ctypes.c_double)),
+        ("labels", ctypes.POINTER(ctypes.c_double)),
+        ("rows", ctypes.c_int64),
+        ("cols", ctypes.c_int64),
+        ("ok", ctypes.c_int32),
+        ("err", ctypes.c_char * 256),
+    ]
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library; returns an error string on failure."""
+    try:
+        # build into a temp file then atomically rename, so concurrent
+        # imports never load a half-written .so
+        with tempfile.NamedTemporaryFile(
+                suffix=".so", dir=_DIR, delete=False) as tmp:
+            tmp_path = tmp.name
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               str(_SRC), "-o", tmp_path]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0:
+            os.unlink(tmp_path)
+            return f"g++ failed: {proc.stderr[-500:]}"
+        os.replace(tmp_path, _LIB_PATH)
+        return None
+    except (OSError, subprocess.SubprocessError) as e:
+        return f"build error: {e}"
+
+
+def _load():
+    global _lib, BUILD_ERROR
+    if _lib is not None:
+        return _lib
+    if (not _LIB_PATH.exists()
+            or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime):
+        BUILD_ERROR = _build()
+        if BUILD_ERROR:
+            return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError as e:
+        BUILD_ERROR = f"dlopen failed: {e}"
+        return None
+    lib.csv_read.restype = ctypes.POINTER(_Table)
+    lib.csv_read.argtypes = [ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32]
+    lib.svmlight_read.restype = ctypes.POINTER(_Table)
+    lib.svmlight_read.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.idx_read.restype = ctypes.POINTER(_Table)
+    lib.idx_read.argtypes = [ctypes.c_char_p]
+    lib.table_free.restype = None
+    lib.table_free.argtypes = [ctypes.POINTER(_Table)]
+    _lib = lib
+    return lib
+
+
+def have_native() -> bool:
+    return _load() is not None
+
+
+def _take(tbl) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    t = tbl.contents
+    if not t.ok:
+        err = t.err.decode(errors="replace")
+        _lib.table_free(tbl)
+        raise ValueError(f"native parse failed: {err}")
+    rows, cols = int(t.rows), int(t.cols)
+    data = np.ctypeslib.as_array(t.data, shape=(rows, cols)).copy()
+    labels = None
+    if t.labels:
+        labels = np.ctypeslib.as_array(t.labels, shape=(rows,)).copy()
+    _lib.table_free(tbl)
+    return data, labels
+
+
+def csv_read(path: str, skip_header: bool = False,
+             label_col: int = -1) -> Tuple[np.ndarray, np.ndarray]:
+    """(features [n, d], labels [n]) — label column extracted."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native unavailable: {BUILD_ERROR}")
+    return _take(lib.csv_read(os.fsencode(path), int(skip_header),
+                              int(label_col)))
+
+
+def svmlight_read(path: str, num_features: int = 0
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """(dense features [n, d], labels [n]); 0 = infer feature count."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native unavailable: {BUILD_ERROR}")
+    return _take(lib.svmlight_read(os.fsencode(path), int(num_features)))
+
+
+def idx_read(path: str) -> np.ndarray:
+    """IDX (MNIST) unsigned-byte tensor as [n, prod(dims)] float64."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native unavailable: {BUILD_ERROR}")
+    data, _ = _take(lib.idx_read(os.fsencode(path)))
+    return data

@@ -1,0 +1,128 @@
+"""Native C++ data-IO tests: build the library, assert parse parity with
+the pure-Python paths, and exercise the prefetch iterator."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+from deeplearning4j_tpu.datasets import (
+    ArrayDataSetIterator,
+    PrefetchDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.fetchers import (
+    csv_dataset,
+    svmlight_dataset,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.have_native(),
+    reason=f"native build unavailable: {native.BUILD_ERROR}")
+
+
+@pytest.fixture
+def csv_file(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("a,b,c,label\n"
+                 "1.5,2.0,-3.25,0\n"
+                 "4.0,5.5,6.0,1\n"
+                 "7.25,-8.0,9.5,2\n")
+    return p
+
+
+@pytest.fixture
+def svm_file(tmp_path):
+    p = tmp_path / "data.svmlight"
+    p.write_text("1 1:0.5 3:1.25  # comment\n"
+                 "0 2:-2.0 qid:7 4:3.5\n"
+                 "1 1:1.0 4:-0.5\n")
+    return p
+
+
+class TestNativeParsers:
+    def test_csv_matches_python(self, csv_file):
+        feats, labels = native.csv_read(str(csv_file), skip_header=True)
+        assert feats.shape == (3, 3)
+        np.testing.assert_allclose(
+            feats, [[1.5, 2.0, -3.25], [4.0, 5.5, 6.0], [7.25, -8.0, 9.5]])
+        np.testing.assert_allclose(labels, [0, 1, 2])
+        ds = csv_dataset(str(csv_file), skip_header=True)
+        np.testing.assert_allclose(ds.features, feats.astype(np.float32))
+
+    def test_svmlight_matches_python(self, svm_file):
+        feats, labels = native.svmlight_read(str(svm_file), 4)
+        assert feats.shape == (3, 4)
+        np.testing.assert_allclose(labels, [1, 0, 1])
+        np.testing.assert_allclose(
+            feats, [[0.5, 0, 1.25, 0], [0, -2.0, 0, 3.5], [1.0, 0, 0, -0.5]])
+        ds = svmlight_dataset(str(svm_file), 4)
+        np.testing.assert_allclose(ds.features, feats.astype(np.float32))
+
+    def test_svmlight_infers_feature_count(self, svm_file):
+        feats, _ = native.svmlight_read(str(svm_file), 0)
+        assert feats.shape[1] == 4
+
+    def test_idx_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 256, (5, 4, 3), dtype=np.uint8)
+        p = tmp_path / "imgs.idx3-ubyte"
+        with open(p, "wb") as f:
+            f.write(struct.pack(">I", 0x00000803))
+            f.write(struct.pack(">III", 5, 4, 3))
+            f.write(imgs.tobytes())
+        data = native.idx_read(str(p))
+        np.testing.assert_array_equal(
+            data, imgs.reshape(5, 12).astype(np.float64))
+
+    def test_error_paths(self, tmp_path):
+        with pytest.raises(ValueError):
+            native.csv_read(str(tmp_path / "missing.csv"))
+        bad = tmp_path / "bad.idx"
+        bad.write_bytes(b"\x00\x01")
+        with pytest.raises(ValueError):
+            native.idx_read(str(bad))
+        empty = tmp_path / "empty.svmlight"
+        empty.write_text("# nothing\n")
+        with pytest.raises(ValueError):
+            native.svmlight_read(str(empty), 0)
+
+
+class TestPrefetch:
+    def test_same_batches_as_base(self):
+        rng = np.random.default_rng(1)
+        x = rng.random((20, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 20)]
+        base = ArrayDataSetIterator(x, y, batch=6)
+        direct = [(b.features.copy(), b.labels.copy()) for b in base]
+        pre = PrefetchDataSetIterator(ArrayDataSetIterator(x, y, batch=6))
+        fetched = [(b.features, b.labels) for b in pre]
+        assert len(direct) == len(fetched)
+        for (fx, fy), (gx, gy) in zip(direct, fetched):
+            np.testing.assert_array_equal(fx, gx)
+            np.testing.assert_array_equal(fy, gy)
+
+    def test_producer_error_propagates(self):
+        class Boom:
+            def __iter__(self):
+                yield from ()
+                raise RuntimeError("boom")
+
+            def reset(self):
+                pass
+
+            def batch_size(self):
+                return 1
+
+            def total_examples(self):
+                return 0
+
+        class BoomIter(Boom):
+            def __iter__(self):
+                if True:
+                    raise RuntimeError("boom")
+                yield None
+
+        with pytest.raises(RuntimeError, match="boom"):
+            list(PrefetchDataSetIterator(BoomIter()))

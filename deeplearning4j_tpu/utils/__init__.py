@@ -1,0 +1,40 @@
+"""Utility tier.
+
+Parity: reference `deeplearning4j-core/.../util/` (MathUtils.java 1,293 LoC,
+Viterbi.java, MovingWindowMatrix, DiskBasedQueue, SerializationUtils,
+ImageLoader) and the vendored Berkeley-NLP `berkeley/` package (Counter,
+CounterMap, Pair). Host-side helpers; the Viterbi decoder is jittable
+(lax.scan) since it is the one with real compute.
+"""
+
+from deeplearning4j_tpu.utils.counter import Counter, CounterMap
+from deeplearning4j_tpu.utils.disk_queue import DiskBasedQueue
+from deeplearning4j_tpu.utils.image_loader import ImageLoader
+from deeplearning4j_tpu.utils.math_utils import (
+    bernoulli_log_likelihood,
+    correlation,
+    cosine_similarity,
+    entropy,
+    euclidean_distance,
+    information_gain,
+    log2,
+    manhattan_distance,
+    normalize,
+    sigmoid,
+    ssq,
+    uniform,
+)
+from deeplearning4j_tpu.utils.moving_window import MovingWindowMatrix
+from deeplearning4j_tpu.utils.serialization import (
+    load_object,
+    save_object,
+)
+from deeplearning4j_tpu.utils.viterbi import Viterbi
+
+__all__ = [
+    "Counter", "CounterMap", "DiskBasedQueue", "ImageLoader",
+    "MovingWindowMatrix", "Viterbi", "save_object", "load_object",
+    "sigmoid", "log2", "entropy", "information_gain", "normalize",
+    "correlation", "cosine_similarity", "euclidean_distance",
+    "manhattan_distance", "ssq", "uniform", "bernoulli_log_likelihood",
+]

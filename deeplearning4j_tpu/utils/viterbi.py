@@ -1,0 +1,73 @@
+"""Viterbi decoding as a jitted lax.scan.
+
+Parity: reference `util/Viterbi.java` (194 LoC — most-likely label sequence
+from per-step outcome probabilities with a Markov transition prior). The
+reference loops in Java; here the forward pass is a `lax.scan` over time
+and the backtrace a reverse scan — both on device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _decode(log_emit: jax.Array, log_trans: jax.Array,
+            log_init: jax.Array):
+    """log_emit [T, S], log_trans [S, S] (from->to), log_init [S] ->
+    (path [T], best_logprob)."""
+
+    def step(prev, emit_t):
+        # prev: [S] best log-prob ending in each state
+        scores = prev[:, None] + log_trans            # [S_from, S_to]
+        best_prev = jnp.argmax(scores, axis=0)         # [S_to]
+        cur = jnp.max(scores, axis=0) + emit_t
+        return cur, best_prev
+
+    first = log_init + log_emit[0]
+    last, backptrs = jax.lax.scan(step, first, log_emit[1:])
+
+    final_state = jnp.argmax(last)
+
+    def back(state, ptr_t):
+        prev = ptr_t[state]
+        return prev, state
+
+    # reverse scan emits the state for times 1..T-1 (final state included);
+    # the last carry is the state at time 0.
+    state0, path_tail = jax.lax.scan(back, final_state, backptrs,
+                                     reverse=True)
+    path = jnp.concatenate([state0[None], path_tail])
+    return path, jnp.max(last)
+
+
+class Viterbi:
+    """decode(emission_probs) -> most likely state sequence."""
+
+    def __init__(self, transition, initial=None, log_space: bool = False):
+        trans = np.asarray(transition, np.float64)
+        if not log_space:
+            trans = np.log(np.maximum(trans, 1e-300))
+        self.log_trans = jnp.asarray(trans, jnp.float32)
+        n = trans.shape[0]
+        if initial is None:
+            init = np.full(n, -np.log(n))
+        else:
+            init = np.asarray(initial, np.float64)
+            if not log_space:
+                init = np.log(np.maximum(init, 1e-300))
+        self.log_init = jnp.asarray(init, jnp.float32)
+
+    def decode(self, emissions, log_space: bool = False):
+        """emissions [T, S] (probabilities unless log_space). Returns
+        (states [T] np.int32, best_logprob)."""
+        e = np.asarray(emissions, np.float64)
+        if not log_space:
+            e = np.log(np.maximum(e, 1e-300))
+        path, logp = _decode(jnp.asarray(e, jnp.float32), self.log_trans,
+                             self.log_init)
+        return np.asarray(path), float(logp)

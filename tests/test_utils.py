@@ -1,0 +1,144 @@
+"""Utility-tier tests (reference: MathUtils/Viterbi/Counter usage across
+the codebase; SURVEY §2.1 util/berkeley rows)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.utils import (
+    Counter,
+    CounterMap,
+    DiskBasedQueue,
+    ImageLoader,
+    MovingWindowMatrix,
+    Viterbi,
+    correlation,
+    cosine_similarity,
+    entropy,
+    euclidean_distance,
+    information_gain,
+    load_object,
+    manhattan_distance,
+    normalize,
+    save_object,
+    sigmoid,
+    ssq,
+)
+
+
+class TestMathUtils:
+    def test_sigmoid_entropy(self):
+        assert sigmoid(0.0) == pytest.approx(0.5)
+        assert entropy([0.5, 0.5]) == pytest.approx(1.0)
+        assert entropy([1.0, 0.0]) == pytest.approx(0.0)
+
+    def test_information_gain(self):
+        # perfect split of a 50/50 parent -> gain = 1 bit
+        gain = information_gain([0.5, 0.5], [[1.0], [1.0]], [0.5, 0.5])
+        assert gain == pytest.approx(1.0)
+
+    def test_normalize_and_distances(self):
+        out = normalize([2, 4, 6], 0, 1)
+        np.testing.assert_allclose(out, [0, 0.5, 1.0])
+        assert euclidean_distance([0, 0], [3, 4]) == pytest.approx(5.0)
+        assert manhattan_distance([0, 0], [3, 4]) == pytest.approx(7.0)
+        assert ssq([1, 2, 3]) == pytest.approx(14.0)
+
+    def test_correlation_and_cosine(self):
+        assert correlation([1, 2, 3], [2, 4, 6]) == pytest.approx(1.0)
+        assert correlation([1, 2, 3], [-1, -2, -3]) == pytest.approx(-1.0)
+        assert cosine_similarity([1, 0], [0, 1]) == pytest.approx(0.0)
+
+
+class TestViterbi:
+    def test_recovers_obvious_path(self):
+        # two states; strong self-transitions; emissions flip mid-sequence
+        trans = [[0.9, 0.1], [0.1, 0.9]]
+        v = Viterbi(trans, initial=[0.5, 0.5])
+        emissions = [[0.9, 0.1]] * 4 + [[0.1, 0.9]] * 4
+        path, logp = v.decode(emissions)
+        np.testing.assert_array_equal(path, [0, 0, 0, 0, 1, 1, 1, 1])
+        assert np.isfinite(logp)
+
+    def test_transition_prior_overrides_weak_emissions(self):
+        # emissions mildly prefer alternating, but transitions forbid it
+        trans = [[0.99, 0.01], [0.01, 0.99]]
+        v = Viterbi(trans, initial=[1.0, 0.0])
+        emissions = [[0.6, 0.4], [0.4, 0.6], [0.6, 0.4], [0.4, 0.6]]
+        path, _ = v.decode(emissions)
+        assert len(set(path.tolist())) == 1  # stays in one state
+
+
+class TestCounters:
+    def test_counter_basics(self):
+        c = Counter("aabbbc")
+        assert c.get_count("b") == 3
+        assert c.arg_max() == "b"
+        assert c.total_count() == 6
+        c.normalize()
+        assert c.get_count("a") == pytest.approx(1 / 3)
+
+    def test_counter_map(self):
+        cm = CounterMap()
+        cm.increment("the", "cat")
+        cm.increment("the", "cat")
+        cm.increment("the", "dog")
+        assert cm.get_count("the", "cat") == 2
+        assert cm.get_counter("the").arg_max() == "cat"
+        cm.normalize()
+        assert cm.get_count("the", "dog") == pytest.approx(1 / 3)
+
+
+class TestDiskQueue:
+    def test_fifo_roundtrip(self, tmp_path):
+        with DiskBasedQueue(str(tmp_path / "q")) as q:
+            for i in range(5):
+                q.add({"i": i, "data": np.arange(i)})
+            assert len(q) == 5
+            assert q.peek()["i"] == 0
+            for i in range(5):
+                item = q.poll()
+                assert item["i"] == i
+            assert q.empty()
+            with pytest.raises(IndexError):
+                q.poll()
+
+
+class TestMovingWindow:
+    def test_all_windows(self):
+        m = np.arange(16).reshape(4, 4)
+        wins = MovingWindowMatrix(m, 2, 2).windows()
+        assert len(wins) == 9
+        np.testing.assert_array_equal(wins[0], [[0, 1], [4, 5]])
+        np.testing.assert_array_equal(wins[-1], [[10, 11], [14, 15]])
+
+    def test_rotations(self):
+        m = np.arange(9).reshape(3, 3)
+        wins = MovingWindowMatrix(m, 2, 2, add_rotate=True).windows()
+        assert len(wins) == 4 * 4  # 4 windows + 3 rotations each
+
+
+class TestSerialization:
+    def test_atomic_roundtrip(self, tmp_path):
+        obj = {"params": np.arange(10), "name": "net"}
+        p = tmp_path / "obj.pkl"
+        save_object(obj, p)
+        back = load_object(p)
+        np.testing.assert_array_equal(back["params"], obj["params"])
+        assert not list(tmp_path.glob("*.tmp"))
+
+
+class TestImageLoader:
+    def test_load_resize_grayscale(self, tmp_path):
+        from PIL import Image
+
+        img = Image.fromarray(
+            (np.random.default_rng(0).random((20, 30, 3)) * 255
+             ).astype(np.uint8))
+        p = tmp_path / "img.png"
+        img.save(p)
+        loader = ImageLoader(height=8, width=8)
+        arr = loader.load(str(p))
+        assert arr.shape == (8, 8)
+        assert 0 <= arr.min() and arr.max() <= 1
+        mat = loader.as_matrix([str(p), str(p)])
+        assert mat.shape == (2, 64)

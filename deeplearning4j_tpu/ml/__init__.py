@@ -1,0 +1,25 @@
+"""High-level estimator/pipeline API.
+
+Parity: reference `dl4j-spark-ml` (Scala) — Spark ML pipeline integration:
+`MultiLayerNetworkClassification.scala:47` (Estimator whose train() runs
+ParameterAveragingTrainingStrategy, model predicts on the driver),
+`MultiLayerNetworkReconstruction.scala` (unsupervised hidden-layer
+transform), `ml/Unsupervised.scala`. The TPU-native equivalent drops Spark:
+estimators wrap `MultiLayerNetwork` (optionally the SPMD
+`DataParallelTrainer` — the psum analog of parameter averaging) behind the
+fit/transform/predict convention Python ML code expects.
+"""
+
+from deeplearning4j_tpu.ml.pipeline import (
+    NetworkClassifier,
+    NetworkReconstruction,
+    Pipeline,
+    StandardScaler,
+)
+
+__all__ = [
+    "NetworkClassifier",
+    "NetworkReconstruction",
+    "Pipeline",
+    "StandardScaler",
+]

@@ -1,0 +1,155 @@
+"""Multi-host launching, cluster config registry, pod provisioning.
+
+Parity targets (SURVEY §2.3):
+- Spark/YARN launchers + `jax.distributed`: `initialize_multihost` wraps
+  `jax.distributed.initialize` — the coordinator-service handshake over DCN
+  that puts every host into one SPMD program, taking the role Spark's
+  driver/executor bootstrap and the YARN ApplicationMaster played.
+- ZooKeeper config registry (`ZooKeeperConfigurationRegister.java` /
+  `ZookeeperConfigurationRetriever.java`): `ClusterConfigRegistry` —
+  register/retrieve JSON configs, backed by a shared directory or by the
+  scaleout tracker server (tracker_server.py) instead of znodes.
+- AWS provisioning (`Ec2BoxCreator.java`, `HostProvisioner.java` via SSH):
+  `TpuPodProvisioner` — generates the gcloud TPU-VM create/ssh/delete
+  command lines for a pod slice. Command GENERATION is in-scope and tested;
+  actually executing them needs cloud credentials and runs outside this
+  environment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> dict:
+    """Join this host into the multi-host SPMD job.
+
+    On TPU pods every argument auto-detects from the TPU metadata
+    environment (jax.distributed does the discovery); pass explicit values
+    for CPU/GPU clusters or tests. Returns a summary dict. Safe to call
+    once per process, before any jax computation.
+    """
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+class ClusterConfigRegistry:
+    """Register/retrieve named JSON configs cluster-wide.
+
+    backend="dir": a shared filesystem directory (NFS/GCS-fuse) holds one
+    JSON file per key — the znode analog.
+    backend="tracker": the scaleout TCP tracker's global map serves the
+    configs (pass a StateTracker/RemoteStateTracker as `tracker`).
+    """
+
+    def __init__(self, directory: Optional[str] = None, tracker=None):
+        if (directory is None) == (tracker is None):
+            raise ValueError("pass exactly one of directory / tracker")
+        self.directory = directory
+        self.tracker = tracker
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+
+    def register(self, key: str, config: dict) -> None:
+        if self.tracker is not None:
+            self.tracker.set_global(f"config/{key}", json.dumps(config))
+            return
+        path = pathlib.Path(self.directory) / f"{key}.json"
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(config, indent=2, sort_keys=True))
+        tmp.replace(path)
+
+    def retrieve(self, key: str) -> dict:
+        if self.tracker is not None:
+            raw = self.tracker.get_global(f"config/{key}")
+            if raw is None:
+                raise KeyError(key)
+            return json.loads(raw)
+        path = pathlib.Path(self.directory) / f"{key}.json"
+        if not path.exists():
+            raise KeyError(key)
+        return json.loads(path.read_text())
+
+    def keys(self) -> List[str]:
+        if self.tracker is not None:
+            raise NotImplementedError("tracker backend lists via tracker")
+        return sorted(p.stem for p in
+                      pathlib.Path(self.directory).glob("*.json"))
+
+
+@dataclass
+class TpuPodProvisioner:
+    """gcloud command generation for a TPU pod slice (EC2-provisioner
+    parity — declarative box creation + per-host command fan-out)."""
+
+    name: str
+    zone: str
+    accelerator_type: str = "v5litepod-8"
+    runtime_version: str = "v2-alpha-tpuv5-lite"
+    project: Optional[str] = None
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def _flag(self, name: str, value: str) -> List[str]:
+        return [f"--{name}={value}"]
+
+    def create_command(self, spot: bool = False) -> List[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "create", self.name,
+               *self._flag("zone", self.zone),
+               *self._flag("accelerator-type", self.accelerator_type),
+               *self._flag("version", self.runtime_version)]
+        if self.project:
+            cmd += self._flag("project", self.project)
+        if spot:
+            cmd.append("--spot")
+        if self.labels:
+            cmd += self._flag("labels", ",".join(
+                f"{k}={v}" for k, v in sorted(self.labels.items())))
+        return cmd
+
+    def run_command(self, shell_command: str,
+                    worker: str = "all") -> List[str]:
+        """SSH fan-out to pod workers (HostProvisioner.runRemoteCommand)."""
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", self.name,
+               *self._flag("zone", self.zone),
+               *self._flag("worker", worker),
+               *self._flag("command", shell_command)]
+        if self.project:
+            cmd += self._flag("project", self.project)
+        return cmd
+
+    def scp_command(self, local: str, remote: str,
+                    worker: str = "all") -> List[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "scp", local,
+               f"{self.name}:{remote}",
+               *self._flag("zone", self.zone),
+               *self._flag("worker", worker)]
+        if self.project:
+            cmd += self._flag("project", self.project)
+        return cmd
+
+    def delete_command(self) -> List[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "delete", self.name,
+               *self._flag("zone", self.zone), "--quiet"]
+        if self.project:
+            cmd += self._flag("project", self.project)
+        return cmd

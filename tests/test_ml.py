@@ -1,0 +1,132 @@
+"""Estimator/pipeline + launcher/registry tests (reference: dl4j-spark-ml
+estimator tests; zookeeper register/retrieve tests; SURVEY §2.3)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.fetchers import iris_dataset
+from deeplearning4j_tpu.ml import (
+    NetworkClassifier,
+    NetworkReconstruction,
+    Pipeline,
+    StandardScaler,
+)
+from deeplearning4j_tpu.nn.conf import (
+    AutoEncoderConf,
+    DenseLayerConf,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayerConf,
+)
+from deeplearning4j_tpu.runtime.launcher import (
+    ClusterConfigRegistry,
+    TpuPodProvisioner,
+)
+
+
+def _clf_conf():
+    return MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=0.01, updater="adam",
+                                    seed=3),
+        layers=(DenseLayerConf(n_in=4, n_out=16, activation="relu"),
+                OutputLayerConf(n_in=16, n_out=3)))
+
+
+class TestNetworkClassifier:
+    def test_fit_predict_score_iris(self):
+        ds = iris_dataset()
+        clf = NetworkClassifier(_clf_conf(), epochs=60, batch_size=32)
+        clf.fit(ds.features, ds.labels)
+        assert clf.score(ds.features, ds.labels) > 0.9
+        proba = clf.predict_proba(ds.features[:5])
+        assert proba.shape == (5, 3)
+        np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-5)
+
+    def test_integer_labels_accepted(self):
+        ds = iris_dataset()
+        y_int = ds.labels.argmax(1)
+        clf = NetworkClassifier(_clf_conf(), epochs=30)
+        clf.fit(ds.features, y_int)
+        assert clf.score(ds.features, y_int) > 0.8
+
+    def test_distributed_training_mode(self):
+        ds = iris_dataset()
+        clf = NetworkClassifier(_clf_conf(), epochs=40, batch_size=32,
+                                distributed=True)
+        clf.fit(ds.features, ds.labels)
+        assert clf.score(ds.features, ds.labels) > 0.85
+
+    def test_get_set_params(self):
+        clf = NetworkClassifier(_clf_conf(), epochs=5)
+        assert clf.get_params()["epochs"] == 5
+        clf.set_params(epochs=7)
+        assert clf.epochs == 7
+        with pytest.raises(ValueError):
+            clf.set_params(nonsense=1)
+
+
+class TestPipeline:
+    def test_scaler_plus_classifier(self):
+        ds = iris_dataset(normalize=False)
+        pipe = Pipeline([
+            ("scale", StandardScaler()),
+            ("net", NetworkClassifier(_clf_conf(), epochs=60)),
+        ])
+        pipe.fit(ds.features, ds.labels)
+        assert pipe.score(ds.features, ds.labels) > 0.9
+
+    def test_reconstruction_transform(self):
+        ds = iris_dataset()
+        conf = MultiLayerConfiguration(
+            conf=NeuralNetConfiguration(learning_rate=0.01, updater="adam"),
+            layers=(AutoEncoderConf(n_in=4, n_out=8),
+                    OutputLayerConf(n_in=8, n_out=1)))
+        rec = NetworkReconstruction(conf, epochs=5, layer=1)
+        feats = rec.fit_transform(ds.features)
+        assert feats.shape == (150, 8)
+        assert np.all(np.isfinite(feats))
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError):
+            Pipeline([("a", StandardScaler()),
+                      ("a", StandardScaler())]).fit(np.zeros((2, 2)))
+
+
+class TestClusterConfigRegistry:
+    def test_dir_backend_roundtrip(self, tmp_path):
+        reg = ClusterConfigRegistry(directory=str(tmp_path))
+        reg.register("job1", {"lr": 0.1, "mesh": [2, 4]})
+        assert reg.retrieve("job1") == {"lr": 0.1, "mesh": [2, 4]}
+        assert reg.keys() == ["job1"]
+        with pytest.raises(KeyError):
+            reg.retrieve("nope")
+
+    def test_tracker_backend_roundtrip(self):
+        from deeplearning4j_tpu.scaleout import StateTracker
+
+        t = StateTracker()
+        reg = ClusterConfigRegistry(tracker=t)
+        reg.register("job2", {"epochs": 3})
+        assert reg.retrieve("job2") == {"epochs": 3}
+
+    def test_exactly_one_backend(self, tmp_path):
+        with pytest.raises(ValueError):
+            ClusterConfigRegistry()
+        with pytest.raises(ValueError):
+            ClusterConfigRegistry(directory=str(tmp_path), tracker=object())
+
+
+class TestTpuPodProvisioner:
+    def test_commands(self):
+        prov = TpuPodProvisioner(name="pod0", zone="us-east5-b",
+                                 project="proj", labels={"team": "ml"})
+        create = prov.create_command(spot=True)
+        assert create[:6] == ["gcloud", "compute", "tpus", "tpu-vm",
+                              "create", "pod0"]
+        assert "--spot" in create
+        assert "--labels=team=ml" in create
+        run = prov.run_command("pip install -e .", worker="all")
+        assert "--command=pip install -e ." in run
+        assert "--worker=all" in run
+        delete = prov.delete_command()
+        assert "pod0" in delete and "--quiet" in delete

@@ -1,0 +1,186 @@
+"""Import external models into the framework.
+
+Parity-plus: the reference reserved a whole module for model import and
+never built it (`dl4j-caffe/` — pom only, zero sources, SURVEY §2.4). Here
+import actually works, for the ecosystem that matters now: PyTorch. A
+`torch.nn.Sequential` of Linear/Conv2d/MaxPool2d/Flatten/activations (the
+Caffe-era layer vocabulary) converts to a `MultiLayerConfiguration` +
+parameter tree, with layouts transposed for our conventions:
+
+- Linear.weight [out, in]        -> W [in, out]
+- Conv2d.weight [out, in, kh, kw] -> W [kh, kw, in, out]  (HWIO / NHWC)
+
+Note the NCHW->NHWC difference also applies to INPUTS at inference time.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import (
+    ConvolutionLayerConf,
+    DenseLayerConf,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayerConf,
+    SubsamplingLayerConf,
+)
+
+_ACTIVATIONS = {
+    "ReLU": "relu",
+    "Tanh": "tanh",
+    "Sigmoid": "sigmoid",
+    "Softmax": "softmax",
+    "GELU": "gelu",
+    "LeakyReLU": "leakyrelu",
+    "Identity": "identity",
+}
+
+
+def _next_activation(mods: List, i: int) -> Tuple[str, int]:
+    """Peek whether module i+1 is an activation; returns (name, skip)."""
+    if i + 1 < len(mods):
+        name = type(mods[i + 1]).__name__
+        if name in _ACTIVATIONS:
+            return _ACTIVATIONS[name], 1
+    return "identity", 0
+
+
+def import_torch_sequential(model, learning_rate: float = 0.01,
+                            updater: str = "sgd"):
+    """torch.nn.Sequential -> (MultiLayerNetwork, conversion report).
+
+    The LAST Linear becomes an OutputLayerConf (softmax + cross-entropy by
+    convention, matching how Caffe/DL4J classifiers terminate).
+    """
+    import jax.numpy as jnp
+    import torch
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+
+    mods = list(model)
+    last_linear = max((i for i, m in enumerate(mods)
+                      if isinstance(m, torch.nn.Linear)), default=None)
+    if last_linear is None:
+        raise ValueError("no Linear layer found — nothing to classify with")
+
+    confs: List = []
+    params: List[dict] = []
+    report: List[str] = []
+    preprocessors = {}
+    last_channels: Optional[int] = None   # conv channels for flatten reorder
+    pending_flatten = False
+    i = 0
+    while i < len(mods):
+        m = mods[i]
+        name = type(m).__name__
+        if isinstance(m, torch.nn.Linear):
+            w = m.weight.detach().numpy().T          # [in, out]
+            if pending_flatten and last_channels:
+                # torch flattened NCHW (channel-major); our cnn_to_ffn
+                # preprocessor flattens NHWC (channel-last): permute the
+                # weight ROWS accordingly. H/W split assumed square.
+                c = last_channels
+                hw = w.shape[0] // c
+                side = int(round(hw ** 0.5))
+                if side * side != hw:
+                    raise ValueError(
+                        "cannot infer square spatial dims for flatten "
+                        f"reorder (features={w.shape[0]}, channels={c})")
+                idx = (np.arange(w.shape[0])
+                       .reshape(c, side, side)      # torch (c, h, w) order
+                       .transpose(1, 2, 0)          # ours  (h, w, c)
+                       .ravel())
+                w = w[idx]
+                report.append("flatten reorder: NCHW->NHWC row permutation")
+            pending_flatten = False
+            b = (m.bias.detach().numpy() if m.bias is not None
+                 else np.zeros(w.shape[1], np.float32))
+            if i == last_linear:
+                confs.append(OutputLayerConf(
+                    n_in=w.shape[0], n_out=w.shape[1]))
+                report.append(f"{name} -> OutputLayer"
+                              f" [{w.shape[0]}->{w.shape[1]}]")
+                i += 1
+            else:
+                act, skip = _next_activation(mods, i)
+                confs.append(DenseLayerConf(
+                    n_in=w.shape[0], n_out=w.shape[1], activation=act))
+                report.append(f"{name}(+{act}) -> DenseLayer")
+                i += 1 + skip
+            params.append({"W": jnp.asarray(w, jnp.float32),
+                           "b": jnp.asarray(b, jnp.float32)})
+        elif isinstance(m, torch.nn.Conv2d):
+            if m.groups != 1:
+                raise ValueError("grouped conv import not supported")
+            w = np.transpose(m.weight.detach().numpy(), (2, 3, 1, 0))  # HWIO
+            b = (m.bias.detach().numpy() if m.bias is not None
+                 else np.zeros(w.shape[3], np.float32))
+            act, skip = _next_activation(mods, i)
+            pad = m.padding if isinstance(m.padding, str) else (
+                "SAME" if any(np.atleast_1d(m.padding)) else "VALID")
+            confs.append(ConvolutionLayerConf(
+                n_in=w.shape[2], n_out=w.shape[3],
+                kernel_size=(w.shape[0], w.shape[1]),
+                stride=tuple(np.atleast_1d(m.stride)[:2].tolist())
+                if np.atleast_1d(m.stride).size else (1, 1),
+                padding=pad if isinstance(pad, str) else "VALID",
+                activation=act))
+            report.append(f"{name}(+{act}) -> ConvolutionLayer "
+                          f"k={w.shape[0]}x{w.shape[1]}")
+            last_channels = w.shape[3]
+            params.append({"W": jnp.asarray(w, jnp.float32),
+                           "b": jnp.asarray(b, jnp.float32)})
+            i += 1 + skip
+        elif isinstance(m, torch.nn.MaxPool2d):
+            k = m.kernel_size if isinstance(m.kernel_size, tuple) else (
+                m.kernel_size, m.kernel_size)
+            s = m.stride if isinstance(m.stride, tuple) else (
+                (m.stride, m.stride) if m.stride else k)
+            confs.append(SubsamplingLayerConf(kernel_size=k, stride=s,
+                                              pooling_type="max"))
+            report.append(f"{name} -> SubsamplingLayer k={k}")
+            params.append({})
+            i += 1
+        elif isinstance(m, torch.nn.AvgPool2d):
+            k = m.kernel_size if isinstance(m.kernel_size, tuple) else (
+                m.kernel_size, m.kernel_size)
+            s = m.stride if isinstance(m.stride, tuple) else (
+                (m.stride, m.stride) if m.stride else k)
+            confs.append(SubsamplingLayerConf(kernel_size=k, stride=s,
+                                              pooling_type="avg"))
+            report.append(f"{name} -> SubsamplingLayer(avg) k={k}")
+            params.append({})
+            i += 1
+        elif isinstance(m, torch.nn.Flatten):
+            preprocessors[str(len(confs))] = {"type": "cnn_to_ffn"}
+            report.append(f"{name} -> cnn_to_ffn preprocessor")
+            pending_flatten = True
+            i += 1
+        elif isinstance(m, torch.nn.Dropout):
+            report.append(f"{name} -> folded into surrounding layers "
+                          "(inference import)")
+            i += 1
+        elif name in _ACTIVATIONS:
+            # standalone activation not consumed by a previous layer
+            report.append(f"{name} -> skipped (leading activation)")
+            i += 1
+        else:
+            raise ValueError(f"unsupported module for import: {name}")
+
+    mlc = MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=learning_rate,
+                                    updater=updater),
+        layers=tuple(confs),
+        input_preprocessors=preprocessors)
+    net = MultiLayerNetwork(mlc).init()
+    for li, p in enumerate(params):
+        for key, val in p.items():
+            if net.params[li][key].shape != val.shape:
+                raise ValueError(
+                    f"layer {li} param {key}: shape "
+                    f"{val.shape} != expected {net.params[li][key].shape}")
+            net.params[li][key] = val
+    return net, report

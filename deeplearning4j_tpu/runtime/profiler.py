@@ -1,0 +1,108 @@
+"""Tracing/profiling.
+
+The reference has NO profiling subsystem (SURVEY §5: "none — the
+observation hook is the IterationListener SPI"). Here profiling is
+first-class, per the survey's recommendation:
+
+- `trace(logdir)`: context manager around `jax.profiler` emitting a
+  TensorBoard-loadable XLA trace (device timelines, HLO cost analysis).
+- `StepTimer`: listener-shaped wall-clock stats (mean/p50/p95 step time,
+  examples/sec) — drop it into the same listener slot as
+  ScoreIterationListener.
+- `annotate(name)`: named span visible inside the device trace
+  (jax.profiler.TraceAnnotation).
+- `device_memory_stats()`: per-device live/peak HBM bytes where the
+  backend exposes them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import statistics
+import time
+from typing import Dict, List, Optional
+
+
+@contextlib.contextmanager
+def trace(logdir: str, create_perfetto_link: bool = False):
+    """Capture a jax.profiler trace into `logdir` (TensorBoard format)."""
+    import jax
+
+    jax.profiler.start_trace(logdir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span for the device timeline (use as a context manager)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory_stats() -> List[Dict]:
+    """Per-device memory stats (bytes) where the backend reports them."""
+    import jax
+
+    out = []
+    for d in jax.devices():
+        stats = {}
+        try:
+            raw = d.memory_stats()
+            if raw:
+                stats = {k: raw[k] for k in
+                         ("bytes_in_use", "peak_bytes_in_use",
+                          "bytes_limit") if k in raw}
+        except (AttributeError, NotImplementedError, RuntimeError):
+            pass
+        out.append({"device": str(d), **stats})
+    return out
+
+
+class StepTimer:
+    """Iteration listener recording wall-clock step times.
+
+    Register with `net.add_listener(StepTimer(batch_size=...))`; read
+    `.summary()` (mean/p50/p95 seconds, steps/sec, examples/sec). The first
+    `skip` steps are excluded (jit compilation)."""
+
+    def __init__(self, batch_size: Optional[int] = None, skip: int = 1):
+        self.batch_size = batch_size
+        self.skip = skip
+        self._last: Optional[float] = None
+        self._times: List[float] = []
+        self._seen = 0
+
+    def __call__(self, iteration: int, score: float) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._seen += 1
+            if self._seen > self.skip:
+                self._times.append(now - self._last)
+        self._last = now
+
+    def reset(self) -> None:
+        self._last, self._times, self._seen = None, [], 0
+
+    @property
+    def times(self) -> List[float]:
+        return list(self._times)
+
+    def summary(self) -> Dict[str, float]:
+        if not self._times:
+            return {"steps": 0}
+        ts = sorted(self._times)
+        mean = statistics.fmean(ts)
+        out = {
+            "steps": len(ts),
+            "mean_s": mean,
+            "p50_s": ts[len(ts) // 2],
+            "p95_s": ts[min(len(ts) - 1, int(len(ts) * 0.95))],
+            "steps_per_sec": 1.0 / mean if mean else 0.0,
+        }
+        if self.batch_size:
+            out["examples_per_sec"] = self.batch_size / mean
+        return out

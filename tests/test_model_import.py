@@ -1,0 +1,60 @@
+"""Torch model import tests (fills the reference's empty dl4j-caffe module
+with a working import path). The gold check: imported network's outputs
+must match the torch model's outputs on the same inputs."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from deeplearning4j_tpu.runtime.model_import import (  # noqa: E402
+    import_torch_sequential,
+)
+
+
+def test_mlp_import_matches_torch():
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 16),
+        torch.nn.ReLU(),
+        torch.nn.Linear(16, 8),
+        torch.nn.Tanh(),
+        torch.nn.Linear(8, 3),
+    )
+    net, report = import_torch_sequential(model)
+    x = np.random.default_rng(0).random((10, 4)).astype(np.float32)
+    with torch.no_grad():
+        want = torch.softmax(model(torch.from_numpy(x)), dim=1).numpy()
+    got = np.asarray(net.label_probabilities(x))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    assert any("OutputLayer" in r for r in report)
+
+
+def test_conv_import_matches_torch():
+    torch.manual_seed(1)
+    model = torch.nn.Sequential(
+        torch.nn.Conv2d(1, 4, 3),          # valid padding
+        torch.nn.ReLU(),
+        torch.nn.MaxPool2d(2),
+        torch.nn.Flatten(),
+        torch.nn.Linear(4 * 5 * 5, 10),
+    )
+    net, report = import_torch_sequential(model)
+    x = np.random.default_rng(1).random((3, 12, 12, 1)).astype(np.float32)
+    with torch.no_grad():
+        t_in = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))
+        want = torch.softmax(model(t_in), dim=1).numpy()
+    got = np.asarray(net.label_probabilities(x))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_unsupported_module_rejected():
+    model = torch.nn.Sequential(torch.nn.Linear(4, 4), torch.nn.LSTM(4, 4))
+    with pytest.raises(ValueError, match="unsupported"):
+        import_torch_sequential(model)
+
+
+def test_no_linear_rejected():
+    model = torch.nn.Sequential(torch.nn.ReLU())
+    with pytest.raises(ValueError, match="no Linear"):
+        import_torch_sequential(model)

@@ -265,3 +265,25 @@ class TestSerde:
         wv = self._small_wv()
         out = wv.analogy("apple", "banana", "cherry", top_n=3)
         assert isinstance(out, list)
+
+
+class TestStemmer:
+    def test_porter_known_pairs(self):
+        from deeplearning4j_tpu.nlp.stemmer import PorterStemmer
+
+        s = PorterStemmer()
+        for word, want in [("caresses", "caress"), ("ponies", "poni"),
+                           ("cats", "cat"), ("agreed", "agre"),
+                           ("plastered", "plaster"), ("motoring", "motor"),
+                           ("happy", "happi"), ("relational", "relat"),
+                           ("conditional", "condit"),
+                           ("rational", "ration"),
+                           ("generalization", "gener"),
+                           ("probate", "probat"), ("cease", "ceas")]:
+            assert s.stem(word) == want, (word, s.stem(word), want)
+
+    def test_stemming_preprocessor(self):
+        from deeplearning4j_tpu.nlp.stemmer import StemmingPreProcessor
+
+        pre = StemmingPreProcessor()
+        assert pre("Running") == "run"

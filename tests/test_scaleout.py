@@ -253,3 +253,35 @@ class TestWord2VecPerformer:
         assert np.abs(job.result["syn1"]).sum() > 0
         performer.update(job.result)
         assert np.abs(w2v.syn0 - start).sum() > 0
+
+
+class TestWordCount:
+    """Reference WordCountTest parity: the non-tensor performer example."""
+
+    def test_distributed_word_count(self):
+        from deeplearning4j_tpu.scaleout.text_performers import (
+            CounterAggregator,
+            WordCountPerformer,
+        )
+
+        docs = [["the cat sat on the mat"],
+                ["the dog sat"],
+                ["a cat and a dog"]]
+
+        def fold(model, agg):
+            if model is None:
+                return agg
+            for k, v in agg.items():
+                model.increment(k, v)
+            return model
+
+        runner = DistributedRunner()
+        result = runner.simulate(
+            payloads=docs,
+            performer_factory=WordCountPerformer,
+            aggregator=CounterAggregator(),
+            apply_aggregate=fold,
+            n_workers=2, timeout=30.0)
+        assert result.get_count("the") == 3
+        assert result.get_count("cat") == 2
+        assert result.get_count("mat") == 1

@@ -41,7 +41,7 @@ def main() -> None:
 
     net, x, y = build()
     for _ in range(WARMUP):
-        net.fit_batch(x, y)
+        net.fit_batch_async(x, y)
     jax.block_until_ready(net.params)
 
     times = []
@@ -49,8 +49,8 @@ def main() -> None:
     for _ in range(STEPS // chunk):
         t0 = time.perf_counter()
         for _ in range(chunk):
-            net.fit_batch(x, y)
-        jax.block_until_ready(net.params)
+            loss = net.fit_batch_async(x, y)
+        jax.block_until_ready(loss)
         times.append((time.perf_counter() - t0) / chunk)
     sec_per_step = float(np.median(times))
     examples_per_sec = BATCH / sec_per_step

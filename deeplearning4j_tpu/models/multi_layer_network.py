@@ -217,7 +217,9 @@ class MultiLayerNetwork:
     def _make_train_step(self):
         updater = self._updater
 
-        @jax.jit
+        # donate the carried training state: params/opt-state buffers are
+        # re-used in place instead of copied every step (HBM hygiene).
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, state, upd_state, x, y, rng, mask):
             def lossfn(p):
                 return self._objective(p, state, x, y, rng, mask)
@@ -230,9 +232,11 @@ class MultiLayerNetwork:
 
         return train_step
 
-    def fit_batch(self, x, y, mask=None) -> float:
-        """One SGD step on one minibatch (reference fit(INDArray,INDArray)
-        :1244). Returns the loss."""
+    def fit_batch_async(self, x, y, mask=None) -> jax.Array:
+        """One SGD step; returns the loss as a DEVICE array without
+        synchronizing, so back-to-back steps pipeline on the chip.
+        Listeners (which need a host float) force a sync only when
+        registered."""
         if self.params is None:
             self.init()
         if self._jit_train_step is None:
@@ -246,10 +250,16 @@ class MultiLayerNetwork:
             self._jit_train_step(self.params, self.state, self.updater_state,
                                  x, y, rng, mask))
         self._iteration += 1
-        loss_f = float(loss)
-        for listener in self._listeners:
-            listener(self._iteration, loss_f)
-        return loss_f
+        if self._listeners:
+            loss_f = float(loss)
+            for listener in self._listeners:
+                listener(self._iteration, loss_f)
+        return loss
+
+    def fit_batch(self, x, y, mask=None) -> float:
+        """One SGD step on one minibatch (reference fit(INDArray,INDArray)
+        :1244). Returns the loss."""
+        return float(self.fit_batch_async(x, y, mask))
 
     def fit(self, data, epochs: int = 1) -> "MultiLayerNetwork":
         """Train from a DataSetIterator-like iterable (yielding objects with
@@ -265,11 +275,14 @@ class MultiLayerNetwork:
                     for b in data]
         if self.conf.pretrain:
             self.pretrain(data, epochs=1)
+        loss = None
         for _ in range(epochs):
             for batch in _as_batches(data):
                 x, y, mask = batch
-                self.fit_batch(x, y, mask)
+                loss = self.fit_batch_async(x, y, mask)
             _maybe_reset(data)
+        if loss is not None:
+            jax.block_until_ready(loss)
         return self
 
     # ---- greedy layer-wise pretraining ------------------------------------

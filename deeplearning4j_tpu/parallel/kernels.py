@@ -1,0 +1,160 @@
+"""Pallas TPU kernels for the hot ops.
+
+The framework's device tier is XLA; Pallas covers the spots where manual
+VMEM scheduling beats the fusion XLA picks (SURVEY §7 "Native components":
+attention is the FLOP/HBM-critical op of the transformer flagship).
+
+`flash_attention(q, k, v, causal)` — fused online-softmax attention:
+one Q block resident in VMEM while K/V stream through, running (m, l, acc)
+accumulators — O(S) memory instead of materializing the [S, S] score
+matrix in HBM. Backward is a custom VJP that recomputes scores densely in
+plain jnp (correctness-first; a fused backward kernel is a further
+optimization).
+
+Off-TPU (tests, CPU meshes) the same kernel runs in Pallas interpret mode,
+so numerics are validated everywhere the suite runs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def flash_enabled() -> bool:
+    """Policy for the transformer's single-device attention path: the
+    Pallas kernel on TPU by default; opt in/out anywhere with
+    DL4J_TPU_FLASH=1/0."""
+    import os
+
+    flag = os.environ.get("DL4J_TPU_FLASH")
+    if flag is not None:
+        return flag.lower() in ("1", "true", "yes")
+    return jax.default_backend() == "tpu"
+
+
+def _pick_block(s: int, target: int = 128) -> int:
+    """Largest divisor of s that is <= target (block sizes must tile S)."""
+    b = min(s, target)
+    while s % b:
+        b -= 1
+    return b
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk,
+                 n_kv_blocks):
+    """Grid program: one (batch*head, q_block) pair.
+
+    q_ref [bq, d]; k_ref/v_ref [s, d] (whole sequence for this bh);
+    o_ref [bq, d].
+    """
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale       # [bq, d]
+    d = q.shape[-1]
+
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        if causal:
+            k_pos = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        blk_m = jnp.max(s, axis=1)                        # [bq]
+        new_m = jnp.maximum(m, blk_m)
+        p = jnp.exp(s - new_m[:, None])
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        scale_old = jnp.exp(m - new_m)
+        l = l * scale_old + jnp.sum(p, axis=1)
+        acc = acc * scale_old[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, d]
+        return new_m, l, acc
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_kv_blocks, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, interpret: bool) -> jax.Array:
+    b, s, h, d = q.shape
+    bq = _pick_block(s)
+    bk = _pick_block(s)
+    n_kv_blocks = s // bk
+    scale = 1.0 / (d ** 0.5)
+
+    # [B,S,H,D] -> [B*H, S, D]
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+
+    kernel = functools.partial(
+        _attn_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+        n_kv_blocks=n_kv_blocks)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _dense_grads(q, k, v, causal, g):
+    """Standard attention backward in plain jnp (dense recompute)."""
+    d = q.shape[-1]
+    scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask[None, :, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    dv = jnp.einsum("bqhk,bqhd->bkhd", p, g)
+    dp = jnp.einsum("bqhd,bkhd->bqhk", g, v)
+    ds = p * (dp - jnp.sum(p * dp, axis=-1, keepdims=True))
+    dq = jnp.einsum("bqhk,bkhd->bqhd", ds, k) * scale
+    dk = jnp.einsum("bqhk,bqhd->bkhd", ds, q) * scale
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = True,
+                    interpret: bool | None = None):
+    """Fused attention [B,S,H,D] -> [B,S,H,D]. interpret=None auto-detects
+    (compiled on TPU, interpreter elsewhere)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, interpret)
+
+
+def _fa_fwd(q, k, v, causal, interpret):
+    return flash_attention(q, k, v, causal, interpret), (q, k, v)
+
+
+def _fa_bwd(causal, interpret, residuals, g):
+    q, k, v = residuals
+    return _dense_grads(q, k, v, causal, g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

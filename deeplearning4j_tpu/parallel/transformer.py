@@ -150,7 +150,12 @@ def _attn(p, x, mesh: Optional[Mesh], axes: MeshAxes, causal: bool):
     k = jnp.einsum("bsd,dhk->bshk", x, p["wk"])
     v = jnp.einsum("bsd,dhk->bshk", x, p["wv"])
     if mesh is None:
-        o = attention(q, k, v, causal=causal)
+        from deeplearning4j_tpu.parallel import kernels
+
+        if kernels.flash_enabled():
+            o = kernels.flash_attention(q, k, v, causal)
+        else:
+            o = attention(q, k, v, causal=causal)
     else:
         spec = P(axes.data, axes.seq, axes.model, None)
         ring = shard_map(

@@ -52,29 +52,117 @@ def _read_idx(path: Path) -> np.ndarray:
         return np.frombuffer(f.read(), np.uint8).reshape(shape)
 
 
+def _load_mnist_dir(d: Path, split: str, binarize: bool,
+                    flatten: bool) -> Optional[DataSet]:
+    prefix = "train" if split == "train" else "t10k"
+    for img_name in (f"{prefix}-images-idx3-ubyte",
+                     f"{prefix}-images.idx3-ubyte"):
+        for suffix in ("", ".gz"):
+            p = d / (img_name + suffix)
+            if p.exists():
+                images = _read_idx(p).astype(np.float32) / 255.0
+                lbl = img_name.replace("images-idx3", "labels-idx1").replace(
+                    "images.idx3", "labels.idx1")
+                labels = _read_idx(d / (lbl + suffix))
+                return _package_mnist(images, labels, binarize, flatten)
+    return None
+
+
 def mnist_dataset(split: str = "train", binarize: bool = False,
-                  flatten: bool = False) -> DataSet:
-    """Real MNIST if MNIST_DIR points at IDX files (reference MnistDataFetcher
-    + MnistManager IDX parsing); else digits-upscaled; else synthetic.
+                  flatten: bool = False,
+                  download: Optional[bool] = None) -> DataSet:
+    """Real MNIST (reference MnistDataFetcher.java:39 + MnistFetcher
+    download-and-cache). Resolution order:
+
+    1. MNIST_DIR env var pointing at IDX files
+    2. the local download cache (~/.cache/deeplearning4j_tpu/mnist)
+    3. download from the mirrors (unless download=False or
+       DL4J_NO_DOWNLOAD=1)
+    4. LOUD fallback: sklearn digits upscaled, else synthetic blobs
+
     Features in [0,1], shape [N,28,28,1] (NHWC) or flat [N,784]."""
+    from deeplearning4j_tpu.datasets import downloader
+
     mnist_dir = os.environ.get("MNIST_DIR")
-    if mnist_dir:
-        d = Path(mnist_dir)
-        prefix = "train" if split == "train" else "t10k"
-        for img_name in (f"{prefix}-images-idx3-ubyte", f"{prefix}-images.idx3-ubyte"):
-            for suffix in ("", ".gz"):
-                p = d / (img_name + suffix)
-                if p.exists():
-                    images = _read_idx(p).astype(np.float32) / 255.0
-                    lbl = img_name.replace("images-idx3", "labels-idx1").replace(
-                        "images.idx3", "labels.idx1")
-                    labels = _read_idx(d / (lbl + suffix))
-                    return _package_mnist(images, labels, binarize, flatten)
+    candidates = [Path(mnist_dir)] if mnist_dir else []
+    candidates.append(downloader.cache_dir("mnist"))
+    for d in candidates:
+        ds = _load_mnist_dir(d, split, binarize, flatten)
+        if ds is not None:
+            return ds
+    if download is not False and downloader.downloads_allowed():
+        try:
+            d = downloader.fetch_mnist()
+            ds = _load_mnist_dir(d, split, binarize, flatten)
+            if ds is not None:
+                return ds
+        except Exception as e:  # noqa: BLE001 — fall back loudly below
+            downloader.warn_fallback(
+                "mnist_dataset", f"download failed ({e})",
+                "sklearn 8x8 digits upscaled to 28x28")
+    else:
+        downloader.warn_fallback(
+            "mnist_dataset", "no cached MNIST and downloads disabled",
+            "sklearn 8x8 digits upscaled to 28x28")
     try:
         return _digits_as_mnist(split, binarize, flatten)
     except Exception:
+        downloader.warn_fallback("mnist_dataset", "sklearn digits unavailable",
+                                 "synthetic Gaussian blobs")
         return synthetic_mnist(6000 if split == "train" else 1000,
                                binarize=binarize, flatten=flatten)
+
+
+def is_real_mnist_available() -> bool:
+    """True when mnist_dataset() would return the actual MNIST data
+    (quality gates should skip otherwise)."""
+    from deeplearning4j_tpu.datasets import downloader
+
+    mnist_dir = os.environ.get("MNIST_DIR")
+    dirs = [Path(mnist_dir)] if mnist_dir else []
+    dirs.append(downloader.cache_dir("mnist"))
+    return any(
+        _load_mnist_dir(d, "test", False, False) is not None for d in dirs)
+
+
+def lfw_dataset(min_faces_per_person: int = 20, resize: float = 0.4,
+                num_classes: Optional[int] = None) -> DataSet:
+    """Labeled Faces in the Wild (reference LFWDataSetIterator).  Downloads
+    via the cache tier; falls back LOUDLY to synthetic face-shaped blobs."""
+    from deeplearning4j_tpu.datasets import downloader
+
+    try:
+        imgs, target, names = downloader.fetch_lfw(min_faces_per_person,
+                                                   resize)
+        if num_classes is not None and num_classes < len(names):
+            keep = target < num_classes
+            imgs, target = imgs[keep], target[keep]
+            k = num_classes
+        else:
+            k = len(names)
+        return DataSet(imgs, one_hot(target, k))
+    except Exception as e:  # noqa: BLE001
+        downloader.warn_fallback("lfw_dataset", f"fetch failed ({e})",
+                                 "synthetic class-conditional blobs")
+        rng = np.random.default_rng(1)
+        k = num_classes or 5
+        n = 200
+        labels = rng.integers(0, k, n)
+        centers = rng.random((k, 50, 37)).astype(np.float32)
+        x = (centers[labels] * 0.6
+             + rng.random((n, 50, 37)).astype(np.float32) * 0.4)
+        return DataSet(x[..., None], one_hot(labels, k))
+
+
+def curves_dataset(n: int = 20000, seed: int = 0) -> DataSet:
+    """The 'curves' autoencoder benchmark (reference CurvesDataFetcher.java:
+    features == labels, used for deep-AE pretraining). Procedurally
+    generated — see downloader.curves_images."""
+    from deeplearning4j_tpu.datasets import downloader
+
+    imgs = downloader.curves_images(n, seed=seed)
+    flat = imgs.reshape(n, -1)
+    return DataSet(flat, flat.copy())
 
 
 def _digits_as_mnist(split: str, binarize: bool, flatten: bool) -> DataSet:
@@ -120,7 +208,9 @@ def csv_dataset(path: str, label_col: int = -1, num_classes: Optional[int] = Non
     try:
         from deeplearning4j_tpu import native
 
-        if native.have_native():
+        # The native parser only splits on comma/semicolon/whitespace;
+        # other delimiters must take the numpy path for identical results.
+        if delimiter in (",", ";") and native.have_native():
             features, labels = native.csv_read(
                 path, skip_header=skip_header, label_col=label_col)
             labels = labels.astype(int)

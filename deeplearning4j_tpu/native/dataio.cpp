@@ -101,6 +101,8 @@ Table* csv_read(const char* path, int32_t skip_header, int32_t label_col) {
         if ((int64_t)row.size() != cols)
           return table_fail(t, "ragged CSV row");
         int64_t lc = label_col < 0 ? cols + label_col : label_col;
+        if (lc < 0 || lc >= cols)
+          return table_fail(t, "label_col out of range");
         for (int64_t i = 0; i < cols; ++i) {
           if (i == lc)
             labels.push_back(row[(size_t)i]);

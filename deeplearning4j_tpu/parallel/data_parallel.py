@@ -18,6 +18,10 @@ Design notes (scaling-book recipe):
 - an async/local-SGD mode (`sync_every > 1`) covers the reference's Hogwild
   router semantics (SURVEY §2.3 item 2): replicas step locally and average
   params every N steps — parameter averaging as an *option*, not the default.
+  Per-replica divergence is real state, so in this mode params/updater-state/
+  layer-state are carried with a leading replica dimension sharded over the
+  data axis (leaf shape [n_devices, ...]); the every-N average is an explicit
+  `lax.pmean` over that axis.
 """
 
 from __future__ import annotations
@@ -62,7 +66,10 @@ class DataParallelTrainer:
         if net.params is None:
             net.init()
         self._updater = make_updater(net.conf.conf.updater_config())
-        self._step_fn = self._build_step()
+        self._step_fn = (self._build_step() if sync_every == 1
+                         else self._build_local_step())
+        self._avg_fn = None
+        self._rep = None  # stacked (params, state, upd_state), local mode
         self._iteration = 0
 
     # ---- the SPMD step ----------------------------------------------------
@@ -71,7 +78,6 @@ class DataParallelTrainer:
         net = self.net
         updater = self._updater
         axis = self.axis
-        do_sync = self.sync_every == 1
 
         def shard_step(params, state, upd_state, x, y, rng, mask):
             # Different dropout/sampling per shard, same init everywhere.
@@ -82,11 +88,10 @@ class DataParallelTrainer:
 
             (loss, new_state), grads = jax.value_and_grad(
                 lossfn, has_aux=True)(params)
-            if do_sync:
-                # The collective: gradient allreduce over ICI. This single
-                # line replaces Spark broadcast+accumulate, Akka
-                # IterativeReduce, and the YARN master (SURVEY §3.2).
-                grads = lax.pmean(grads, axis)
+            # The collective: gradient allreduce over ICI. This single
+            # line replaces Spark broadcast+accumulate, Akka
+            # IterativeReduce, and the YARN master (SURVEY §3.2).
+            grads = lax.pmean(grads, axis)
             loss = lax.pmean(loss, axis)
             new_state = jax.tree_util.tree_map(
                 lambda s: lax.pmean(s, axis) if jnp.issubdtype(
@@ -108,11 +113,65 @@ class DataParallelTrainer:
         )
         return jax.jit(fn)
 
+    def _build_local_step(self):
+        """Local-SGD step: each replica holds ITS OWN params slice (leading
+        replica dim sharded over the data axis) and applies its own gradient
+        with no collective; divergence is representable, unlike declaring
+        unsynced buffers replicated."""
+        net = self.net
+        updater = self._updater
+        axis = self.axis
+
+        def local_step(rep_params, rep_state, rep_upd, x, y, rng, mask):
+            # Each shard sees leaves of shape [1, ...]: this replica's slot.
+            params = jax.tree_util.tree_map(lambda a: a[0], rep_params)
+            state = jax.tree_util.tree_map(lambda a: a[0], rep_state)
+            upd_state = jax.tree_util.tree_map(lambda a: a[0], rep_upd)
+            rng = jax.random.fold_in(rng, lax.axis_index(axis))
+
+            def lossfn(p):
+                return net._objective(p, state, x, y, rng, mask)
+
+            (loss, new_state), grads = jax.value_and_grad(
+                lossfn, has_aux=True)(params)
+            updates, upd_state = updater.update(grads, upd_state, params)
+            params = apply_updates(params, updates)
+            loss = lax.pmean(loss, axis)
+
+            def restack(t):
+                return jax.tree_util.tree_map(
+                    lambda a: jnp.asarray(a)[None], t)
+
+            return (restack(params), restack(new_state), restack(upd_state),
+                    loss)
+
+        rspec = P(self.axis)  # per-replica stacked state
+        dspec = P(self.axis)
+        fn = shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(rspec, rspec, rspec, dspec, dspec, P(), dspec),
+            out_specs=(rspec, rspec, rspec, P()),
+            check_rep=False,
+        )
+        return jax.jit(fn)
+
+    def _stack(self, tree):
+        """[n_devices, ...] copies of every leaf, sharded over the axis."""
+        n = self.n_devices
+        sh = mesh_lib.batch_sharded(self.mesh, self.axis)
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(
+                np.broadcast_to(np.asarray(a), (n,) + np.shape(a)).copy(), sh),
+            tree)
+
     # ---- public API -------------------------------------------------------
 
     def fit_batch(self, x, y, mask=None) -> float:
-        """One synchronous SPMD step over the global batch (dim 0 must be
-        divisible by the mesh's data-axis size)."""
+        """One SPMD step over the global batch (dim 0 must be divisible by
+        the mesh's data-axis size).  sync_every==1: synchronous gradient
+        allreduce.  sync_every>1: local step per replica, params averaged
+        every N steps (net.params reflects the average at sync points)."""
         net = self.net
         x = np.asarray(x)
         y = np.asarray(y)
@@ -126,8 +185,16 @@ class DataParallelTrainer:
         ys = mesh_lib.shard_batch(self.mesh, jnp.asarray(y), self.axis)
         ms = (None if mask is None
               else mesh_lib.shard_batch(self.mesh, jnp.asarray(mask), self.axis))
-        net.params, net.state, net.updater_state, loss = self._step_fn(
-            net.params, net.state, net.updater_state, xs, ys, rng, ms)
+        if self.sync_every == 1:
+            net.params, net.state, net.updater_state, loss = self._step_fn(
+                net.params, net.state, net.updater_state, xs, ys, rng, ms)
+        else:
+            if self._rep is None:
+                self._rep = tuple(self._stack(t) for t in
+                                  (net.params, net.state, net.updater_state))
+            p, s, u = self._rep
+            p, s, u, loss = self._step_fn(p, s, u, xs, ys, rng, ms)
+            self._rep = (p, s, u)
         self._iteration += 1
         if self.sync_every > 1 and self._iteration % self.sync_every == 0:
             self._average_params()
@@ -141,21 +208,42 @@ class DataParallelTrainer:
             for x, y, mask in _as_batches(data):
                 self.fit_batch(x, y, mask)
             _maybe_reset(data)
+        if self.sync_every > 1:
+            self.finalize()
         return self
 
     def _average_params(self) -> None:
-        """Explicit parameter averaging for the local-SGD/Hogwild-parity mode
-        (the reference's every-N averaging, kept for A/B comparisons)."""
-        # With sync_every>1 grads are applied locally; params have drifted
-        # per-replica inside the (replicated-spec but unsynced) buffers only
-        # if check_rep allowed it. For safety re-average through pmean.
-        mesh = self.mesh
-        axis = self.axis
+        """Every-N parameter averaging for the local-SGD/Hogwild-parity mode
+        (the reference's HogWildWorkRouter semantics): pmean over the replica
+        axis of the stacked per-replica state; float updater/layer state is
+        averaged too, so the replicas restart the next round identical."""
+        if self._rep is None:
+            return
+        if self._avg_fn is None:
+            axis = self.axis
 
-        avg = jax.jit(shard_map(
-            lambda p: jax.tree_util.tree_map(lambda a: lax.pmean(a, axis), p),
-            mesh=mesh, in_specs=P(), out_specs=P(), check_rep=False))
-        self.net.params = avg(self.net.params)
+            def avg_tree(t):
+                return jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, axis) if jnp.issubdtype(
+                        a.dtype, jnp.floating) else a, t)
+
+            self._avg_fn = jax.jit(shard_map(
+                lambda p, s, u: (avg_tree(p), avg_tree(s), avg_tree(u)),
+                mesh=self.mesh, in_specs=(P(self.axis),) * 3,
+                out_specs=(P(self.axis),) * 3, check_rep=False))
+        self._rep = self._avg_fn(*self._rep)
+        # Publish the averaged copy (replica 0's slot — all equal now).
+        p, s, u = self._rep
+        unstack = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)  # noqa: E731
+        self.net.params = unstack(p)
+        self.net.state = unstack(s)
+        self.net.updater_state = unstack(u)
+
+    def finalize(self) -> None:
+        """Average any outstanding per-replica drift into net.params
+        (local-SGD mode; no-op for the synchronous path)."""
+        if self.sync_every > 1 and self._rep is not None:
+            self._average_params()
 
     def scaling_report(self) -> dict:
         return {

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.scaleout.api import Job, WorkerPerformer
@@ -35,12 +37,21 @@ class NetworkPerformer(WorkerPerformer):
         x, y = job.work
         for _ in range(self.epochs):
             self.net.fit_batch(np.asarray(x), np.asarray(y))
-        job.result = self.net.params
+        # Publish HOST copies: the live device buffers are donated by the
+        # next fit_batch, so handing them out would let the aggregator (and
+        # any replica that installs the averaged tree) read deleted arrays.
+        job.result = jax.tree_util.tree_map(np.asarray, self.net.params)
         job.done = True
 
     def update(self, state: Any) -> None:
         if state is not None:
-            self.net.params = state
+            # Fresh device buffers per replica: the tracker broadcasts ONE
+            # averaged tree to every performer, and fit_batch donates its
+            # params (multi_layer_network.py donate_argnums) — installing the
+            # shared tree by reference would let the first replica's step
+            # delete buffers the others still hold.
+            self.net.params = jax.tree_util.tree_map(
+                lambda a: jnp.array(a), state)
 
 
 class Word2VecPerformer(WorkerPerformer):

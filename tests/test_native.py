@@ -88,6 +88,12 @@ class TestNativeParsers:
         with pytest.raises(ValueError):
             native.svmlight_read(str(empty), 0)
 
+    def test_csv_label_col_out_of_range(self, tmp_path):
+        f = tmp_path / "t.csv"
+        f.write_text("1,2,3\n4,5,6\n")
+        with pytest.raises(ValueError):
+            native.csv_read(str(f), label_col=7)
+
 
 class TestPrefetch:
     def test_same_batches_as_base(self):

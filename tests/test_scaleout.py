@@ -85,6 +85,44 @@ class TestStateTracker:
 
 
 class TestTrackerServer:
+    def test_rejects_pickle_gadget(self):
+        """A frame whose pickle references a non-allowlisted callable must
+        be rejected before any code runs (ADVICE r1: unauthenticated RCE)."""
+        import pickle
+
+        from deeplearning4j_tpu.scaleout.tracker_server import (
+            _RestrictedUnpickler,
+        )
+
+        class Evil:
+            def __reduce__(self):
+                import os
+                return (os.system, ("echo pwned",))
+
+        import io
+        payload = pickle.dumps(Evil())
+        with pytest.raises(pickle.UnpicklingError):
+            _RestrictedUnpickler(io.BytesIO(payload)).load()
+        # benign control traffic still decodes
+        ok = pickle.dumps(("workers", (), {"arrays": np.ones(2)}))
+        method, args, kwargs = _RestrictedUnpickler(io.BytesIO(ok)).load()
+        assert method == "workers"
+        np.testing.assert_array_equal(kwargs["arrays"], np.ones(2))
+
+    def test_hmac_secret_rejects_unauthenticated_client(self):
+        server = StateTrackerServer(secret="s3cret").start()
+        try:
+            host, port = server.address
+            bad = RemoteStateTracker(host, port, timeout=5.0)
+            with pytest.raises((RuntimeError, ConnectionError, OSError)):
+                bad.workers()
+            good = RemoteStateTracker(host, port, secret="s3cret")
+            good.add_worker("w0")
+            assert good.workers() == ["w0"]
+            good.close()
+        finally:
+            server.stop()
+
     def test_remote_tracker_proxies_full_surface(self):
         server = StateTrackerServer().start()
         try:
@@ -195,6 +233,25 @@ def _tiny_net_json():
 
 
 class TestNetworkPerformer:
+    def test_shared_state_survives_donation(self):
+        """Regression: update() used to install the broadcast tree by
+        reference into every replica; the first fit_batch donated (deleted)
+        those buffers under the other replicas."""
+        conf_json = _tiny_net_json()
+        a = NetworkPerformer(conf_json)
+        b = NetworkPerformer(conf_json)
+        shared = a.net.params  # one tree handed to both, like the tracker
+        a.update(shared)
+        b.update(shared)
+        x = np.random.default_rng(0).random((8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.arange(8) % 3]
+        job_a, job_b = Job(work=(x, y)), Job(work=(x, y))
+        a.perform(job_a)  # donates a's buffers
+        b.perform(job_b)  # must not see deleted arrays
+        for leaf in [l for p in (job_a.result, job_b.result)
+                     for t in p for l in t.values()]:
+            assert np.all(np.isfinite(leaf))
+
     def test_param_averaging_trains_iris(self):
         from deeplearning4j_tpu.datasets.fetchers import iris_dataset
         from deeplearning4j_tpu.models import MultiLayerNetwork

@@ -7,9 +7,13 @@ attention is the FLOP/HBM-critical op of the transformer flagship).
 `flash_attention(q, k, v, causal)` — fused online-softmax attention:
 one Q block resident in VMEM while K/V stream through, running (m, l, acc)
 accumulators — O(S) memory instead of materializing the [S, S] score
-matrix in HBM. Backward is a custom VJP that recomputes scores densely in
-plain jnp (correctness-first; a fused backward kernel is a further
-optimization).
+matrix in HBM. The forward also emits the per-row logsumexp; the backward
+is the FlashAttention-2 scheme: two fused kernels (dK/dV with K-block
+resident and Q/dO streaming, dQ with Q-block resident and K/V streaming)
+that recompute P = exp(S - lse) blockwise, so training memory stays O(S)
+too. Causal blocks that are fully masked are skipped via dynamic loop
+bounds. Set DL4J_TPU_FLASH_BWD=0 to fall back to the dense-recompute
+backward (kept for A/B benchmarking).
 
 Off-TPU (tests, CPU meshes) the same kernel runs in Pallas interpret mode,
 so numerics are validated everywhere the suite runs.
@@ -46,12 +50,13 @@ def _pick_block(s: int, target: int = 128) -> int:
     return b
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk,
-                 n_kv_blocks):
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq,
+                 bk, n_kv_blocks):
     """Grid program: one (batch*head, q_block) pair.
 
     q_ref [bq, d]; k_ref/v_ref [s, d] (whole sequence for this bh);
-    o_ref [bq, d].
+    o_ref [bq, d]; lse_ref [bq] (logsumexp of the scaled scores, consumed
+    by the fused backward).
     """
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale       # [bq, d]
@@ -85,27 +90,37 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk,
     m0 = jnp.full((bq,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, n_kv_blocks, body, (m0, l0, acc0))
+    # Causal: kv blocks past this q block are fully masked — skip them.
+    n_blocks = jnp.minimum(
+        n_kv_blocks, (qi * bq + bq + bk - 1) // bk) if causal else n_kv_blocks
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
-def _flash_forward(q, k, v, causal: bool, interpret: bool) -> jax.Array:
+def _fold(x, b, s, h, d):
+    """[B,S,H,D] -> [B*H, S, D]"""
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold(x, b, s, h, d):
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_forward(q, k, v, causal: bool, interpret: bool):
+    """Returns (out [B,S,H,D], lse [B*H, S])."""
     b, s, h, d = q.shape
     bq = _pick_block(s)
     bk = _pick_block(s)
     n_kv_blocks = s // bk
     scale = 1.0 / (d ** 0.5)
 
-    # [B,S,H,D] -> [B*H, S, D]
-    def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-
-    qf, kf, vf = fold(q), fold(k), fold(v)
+    qf, kf, vf = (_fold(x, b, s, h, d) for x in (q, k, v))
 
     kernel = functools.partial(
         _attn_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
         n_kv_blocks=n_kv_blocks)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, s // bq),
         in_specs=[
@@ -113,11 +128,166 @@ def _flash_forward(q, k, v, causal: bool, interpret: bool) -> jax.Array:
             pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return _unfold(out, b, s, h, d), lse
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, bq, bk, n_q_blocks):
+    """Grid program: one (batch*head, kv_block) pair; K/V block resident,
+    Q/dO/lse/delta stream through in bq-sized blocks."""
+    j = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)              # [bk, d]
+    v_blk = v_ref[0].astype(jnp.float32)
+    d = k_blk.shape[-1]
+    k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(i * bq, bq)]
+        delta_blk = delta_ref[0, pl.ds(i * bq, bq)]
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])                 # [bq, bk]
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bk, d]
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk]
+        ds = p * (dp - delta_blk[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bk, d]
+        return dk, dv
+
+    # Causal: q blocks strictly before this kv block are fully masked.
+    start = (j * bk) // bq if causal else 0
+    zeros = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, n_q_blocks, body, (zeros, zeros))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, bq, bk, n_kv_blocks):
+    """Grid program: one (batch*head, q_block) pair; Q block resident,
+    K/V stream through."""
+    qi = pl.program_id(1)
+    q_blk = q_ref[0].astype(jnp.float32)              # [bq, d]
+    do_blk = do_ref[0].astype(jnp.float32)
+    lse_blk = lse_ref[0]
+    delta_blk = delta_ref[0]
+    d = q_blk.shape[-1]
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    def body(jb, dq):
+        k_blk = k_ref[0, pl.ds(jb * bk, bk), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(jb * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            k_pos = jb * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk]
+        ds = p * (dp - delta_blk[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, d]
+
+    # Causal: kv blocks past this q block are fully masked.
+    n_blocks = jnp.minimum(
+        n_kv_blocks, (qi * bq + bq + bk - 1) // bk) if causal else n_kv_blocks
+    dq = jax.lax.fori_loop(0, n_blocks, body,
+                           jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal: bool, interpret: bool):
+    b, s, h, d = q.shape
+    of = _fold(o, b, s, h, d)
+    gf = _fold(g, b, s, h, d)
+    # delta_i = sum_d dO_i * O_i — the softmax-jacobian row correction
+    # (FlashAttention-2 eq. 4); cheap elementwise, XLA fuses it.
+    delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    return _bwd_block(q, k, v, g, lse, delta, causal, interpret)
+
+
+def _bwd_block(q, k, v, g, lse, delta, causal: bool, interpret: bool):
+    """(dq, dk, dv) for one attention block given the Q-side row stats.
+
+    q/k/v/g: [B,S,H,D]; lse/delta: [B*H, S] float32.  Used both by the
+    single-device VJP and (per ring step, with the GLOBAL lse/delta) by
+    ring attention's distributed backward.
+    """
+    b, s, h, d = q.shape
+    bq = _pick_block(s)
+    bk = _pick_block(s)
+    scale = 1.0 / (d ** 0.5)
+
+    qf, kf, vf, gf = (_fold(x, b, s, h, d) for x in (q, k, v, g))
+
+    dkv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq,
+                          bk=bk, n_q_blocks=s // bq),
+        grid=(b * h, s // bk),
+        in_specs=[
+            pl.BlockSpec((1, s, d), lambda bh, j: (bh, 0, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),  # v
+            pl.BlockSpec((1, s, d), lambda bh, j: (bh, 0, 0)),   # do
+            pl.BlockSpec((1, s), lambda bh, j: (bh, 0)),         # lse
+            pl.BlockSpec((1, s), lambda bh, j: (bh, 0)),         # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+    dkf, dvf = dkv
+
+    dqf = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq,
+                          bk=bk, n_kv_blocks=s // bk),
+        grid=(b * h, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),  # q
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),    # k
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),    # v
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),  # do
+            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),        # lse
+            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),        # delta
+        ],
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    )(qf, kf, vf, gf, lse, delta)
+
+    return tuple(_unfold(x, b, s, h, d) for x in (dqf, dkf, dvf))
 
 
 def _dense_grads(q, k, v, causal, g):
@@ -138,23 +308,39 @@ def _dense_grads(q, k, v, causal, g):
     return dq, dk, dv
 
 
+def _flash_bwd_enabled() -> bool:
+    import os
+
+    return os.environ.get("DL4J_TPU_FLASH_BWD", "1").lower() in (
+        "1", "true", "yes")
+
+
+def _resolve_interpret(interpret):
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal: bool = True,
                     interpret: bool | None = None):
     """Fused attention [B,S,H,D] -> [B,S,H,D]. interpret=None auto-detects
     (compiled on TPU, interpreter elsewhere)."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, causal, interpret)
+    out, _ = _flash_forward(q, k, v, causal, _resolve_interpret(interpret))
+    return out
 
 
 def _fa_fwd(q, k, v, causal, interpret):
-    return flash_attention(q, k, v, causal, interpret), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, _resolve_interpret(interpret))
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, interpret, residuals, g):
-    q, k, v = residuals
-    return _dense_grads(q, k, v, causal, g)
+    q, k, v, o, lse = residuals
+    if not _flash_bwd_enabled():
+        return _dense_grads(q, k, v, causal, g)
+    return _flash_backward(q, k, v, o, lse, g, causal,
+                           _resolve_interpret(interpret))
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -157,9 +157,18 @@ def _attn(p, x, mesh: Optional[Mesh], axes: MeshAxes, causal: bool):
         else:
             o = attention(q, k, v, causal=causal)
     else:
+        from deeplearning4j_tpu.parallel import kernels
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            ring_flash_attention,
+        )
+
+        # Pallas inner block on TPU (fused fwd+bwd, O(S/P) memory);
+        # plain-jnp blockwise ring elsewhere.
+        inner = (ring_flash_attention if kernels.flash_enabled()
+                 else ring_attention)
         spec = P(axes.data, axes.seq, axes.model, None)
         ring = shard_map(
-            lambda q, k, v: ring_attention(q, k, v, axes.seq, causal=causal),
+            lambda q, k, v: inner(q, k, v, axes.seq, causal=causal),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_rep=False)
         o = ring(q, k, v)

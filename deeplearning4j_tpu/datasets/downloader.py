@@ -1,0 +1,170 @@
+"""Dataset downloaders with local caching.
+
+Parity: the reference's fetch-and-cache tier — `base/MnistFetcher.java:48`
+(download MNIST archives into a home-dir cache, untar, point the fetcher at
+the files), `LFWLoader` (`lfw/LFWLoader.java`), and
+`datasets/fetchers/CurvesDataFetcher.java` (S3-hosted curves dataset).
+
+TPU-era shape: stdlib urllib into `~/.cache/deeplearning4j_tpu/<name>`,
+atomic rename after optional SHA-256 verification, loud warning (never a
+silent substitute) when the network is unavailable.  Set
+DL4J_NO_DOWNLOAD=1 to forbid network access entirely (CI / air-gapped
+hosts), DL4J_CACHE_DIR to move the cache.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import os
+import shutil
+import urllib.error
+import urllib.request
+import warnings
+from pathlib import Path
+from typing import Optional, Sequence
+
+
+def cache_dir(name: str) -> Path:
+    root = os.environ.get("DL4J_CACHE_DIR")
+    if root:
+        return Path(root) / name
+    return Path.home() / ".cache" / "deeplearning4j_tpu" / name
+
+
+def downloads_allowed() -> bool:
+    return os.environ.get("DL4J_NO_DOWNLOAD", "").lower() not in (
+        "1", "true", "yes")
+
+
+def download(url: str, dest: Path, sha256: Optional[str] = None,
+             timeout: float = 60.0) -> Path:
+    """Fetch `url` into `dest` (atomic: .part then rename).  Raises
+    URLError/ValueError on failure; existing verified files are reused."""
+    if dest.exists():
+        if sha256 is None or _sha256(dest) == sha256:
+            return dest
+        dest.unlink()  # corrupt cache entry
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    tmp = dest.with_suffix(dest.suffix + ".part")
+    req = urllib.request.Request(
+        url, headers={"User-Agent": "deeplearning4j-tpu/0.2"})
+    with urllib.request.urlopen(req, timeout=timeout) as r, \
+            open(tmp, "wb") as f:
+        shutil.copyfileobj(r, f)
+    if sha256 is not None and _sha256(tmp) != sha256:
+        tmp.unlink()
+        raise ValueError(f"SHA-256 mismatch for {url}")
+    tmp.replace(dest)
+    return dest
+
+
+def _sha256(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# MNIST (reference MnistFetcher.java:48)
+# ---------------------------------------------------------------------------
+
+MNIST_FILES = (
+    "train-images-idx3-ubyte.gz",
+    "train-labels-idx1-ubyte.gz",
+    "t10k-images-idx3-ubyte.gz",
+    "t10k-labels-idx1-ubyte.gz",
+)
+# Primary + mirror, matching the reference's single-source fetcher but with
+# a fallback host; override with MNIST_BASE_URL.
+MNIST_BASE_URLS = (
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    "https://storage.googleapis.com/cvdf-datasets/mnist/",
+)
+
+
+def fetch_mnist(dest: Optional[Path] = None) -> Path:
+    """Download-and-cache the four MNIST IDX archives; returns the directory
+    holding them. Raises if the network is unreachable or forbidden."""
+    dest = Path(dest) if dest else cache_dir("mnist")
+    if all((dest / f).exists() for f in MNIST_FILES):
+        return dest
+    if not downloads_allowed():
+        raise RuntimeError("MNIST download forbidden (DL4J_NO_DOWNLOAD)")
+    bases: Sequence[str] = (
+        (os.environ["MNIST_BASE_URL"],) if os.environ.get("MNIST_BASE_URL")
+        else MNIST_BASE_URLS)
+    last_err: Optional[Exception] = None
+    for fname in MNIST_FILES:
+        if (dest / fname).exists():
+            continue
+        for base in bases:
+            try:
+                download(base.rstrip("/") + "/" + fname, dest / fname)
+                _check_gzip(dest / fname)
+                break
+            except Exception as e:  # noqa: BLE001 — try next mirror
+                last_err = e
+        else:
+            raise RuntimeError(
+                f"could not download {fname} from any mirror: {last_err}")
+    return dest
+
+
+def _check_gzip(path: Path) -> None:
+    with gzip.open(path, "rb") as f:
+        f.read(4)
+
+
+# ---------------------------------------------------------------------------
+# LFW (reference LFWDataSetIterator / LFWLoader)
+# ---------------------------------------------------------------------------
+
+def fetch_lfw(min_faces_per_person: int = 20, resize: float = 0.4):
+    """Labeled Faces in the Wild via sklearn's fetcher (downloads and caches
+    under the same cache root). Returns (images [N,H,W,1] float32 in [0,1],
+    labels int64, target_names)."""
+    if not downloads_allowed():
+        raise RuntimeError("LFW download forbidden (DL4J_NO_DOWNLOAD)")
+    from sklearn.datasets import fetch_lfw_people
+
+    data = fetch_lfw_people(data_home=str(cache_dir("lfw")),
+                            min_faces_per_person=min_faces_per_person,
+                            resize=resize)
+    imgs = (data.images / 255.0).astype("float32")[..., None]
+    return imgs, data.target, data.target_names
+
+
+# ---------------------------------------------------------------------------
+# Curves (reference CurvesDataFetcher.java — S3-hosted synthetic curves)
+# ---------------------------------------------------------------------------
+
+def curves_images(n: int = 20000, size: int = 28, seed: int = 0):
+    """The 'curves' deep-autoencoder benchmark: images of random smooth
+    curves.  The reference downloads a serialized copy from S3; the dataset
+    itself is procedurally generated, so here it is generated directly
+    (same distribution family: random quadratic Bezier strokes)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    t = np.linspace(0.0, 1.0, 64, dtype=np.float32)[:, None]  # [T,1]
+    pts = rng.random((n, 3, 2)).astype(np.float32) * (size - 1)
+    # quadratic Bezier: (1-t)^2 P0 + 2t(1-t) P1 + t^2 P2   -> [n, T, 2]
+    curve = ((1 - t) ** 2)[None] * pts[:, None, 0] \
+        + (2 * t * (1 - t))[None] * pts[:, None, 1] \
+        + (t ** 2)[None] * pts[:, None, 2]
+    imgs = np.zeros((n, size, size), np.float32)
+    xi = np.clip(curve[..., 0].round().astype(int), 0, size - 1)
+    yi = np.clip(curve[..., 1].round().astype(int), 0, size - 1)
+    ni = np.repeat(np.arange(n), t.shape[0])
+    imgs[ni, yi.ravel(), xi.ravel()] = 1.0
+    return imgs
+
+
+def warn_fallback(name: str, reason: str, substitute: str) -> None:
+    warnings.warn(
+        f"{name}: {reason} — substituting {substitute}. Quality numbers "
+        f"from this data are NOT comparable to the real dataset.",
+        RuntimeWarning, stacklevel=3)

@@ -15,6 +15,14 @@ from deeplearning4j_tpu.runtime.checkpoint import (
     save_checkpoint,
     save_model,
 )
+from deeplearning4j_tpu.runtime.storage import (
+    RemoteModelSaver,
+    get_store,
+    load_checkpoint_remote,
+    load_model_remote,
+    remote_dataset,
+    save_checkpoint_remote,
+)
 
 __all__ = [
     "save_model",
@@ -24,4 +32,10 @@ __all__ = [
     "ModelSaver",
     "DiskModelSaver",
     "CheckpointListener",
+    "get_store",
+    "save_checkpoint_remote",
+    "load_checkpoint_remote",
+    "RemoteModelSaver",
+    "load_model_remote",
+    "remote_dataset",
 ]

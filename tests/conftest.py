@@ -24,6 +24,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running quality gates (deselect with "
+        "-m 'not slow')")
+
+
 @pytest.fixture
 def rng_key():
     import jax

@@ -1,0 +1,140 @@
+"""Dataset fetch-and-cache tier: downloader against a local HTTP server
+(no egress needed), IDX parsing, loud fallbacks, curves generator."""
+
+import gzip
+import http.server
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import downloader
+from deeplearning4j_tpu.datasets.fetchers import (
+    curves_dataset,
+    is_real_mnist_available,
+    lfw_dataset,
+    mnist_dataset,
+)
+
+
+def _idx_bytes(arr: np.ndarray) -> bytes:
+    """Serialize an array in IDX format (the MNIST container)."""
+    type_code = {np.uint8: 0x08}[arr.dtype.type]
+    header = struct.pack(">I", (type_code << 8) | arr.ndim)
+    header += struct.pack(">" + "I" * arr.ndim, *arr.shape)
+    return header + arr.tobytes()
+
+
+@pytest.fixture
+def mnist_server(tmp_path):
+    """Local HTTP server hosting a 32-example fake MNIST in real IDX.gz."""
+    rng = np.random.default_rng(0)
+    site = tmp_path / "site"
+    site.mkdir()
+    for prefix, n in (("train", 32), ("t10k", 16)):
+        imgs = rng.integers(0, 256, (n, 28, 28), dtype=np.uint8)
+        labels = rng.integers(0, 10, (n,), dtype=np.uint8)
+        for name, arr in ((f"{prefix}-images-idx3-ubyte", imgs),
+                          (f"{prefix}-labels-idx1-ubyte", labels)):
+            (site / (name + ".gz")).write_bytes(
+                gzip.compress(_idx_bytes(arr)))
+
+    import functools
+
+    class Quiet(http.server.SimpleHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+    handler = functools.partial(Quiet, directory=str(site))
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}/"
+    srv.shutdown()
+
+
+class TestDownloader:
+    def test_fetch_mnist_downloads_and_caches(self, mnist_server, tmp_path,
+                                              monkeypatch):
+        cache = tmp_path / "cache"
+        monkeypatch.setenv("DL4J_CACHE_DIR", str(cache))
+        monkeypatch.setenv("MNIST_BASE_URL", mnist_server)
+        monkeypatch.delenv("DL4J_NO_DOWNLOAD", raising=False)
+        monkeypatch.delenv("MNIST_DIR", raising=False)
+
+        d = downloader.fetch_mnist()
+        assert all((d / f).exists() for f in downloader.MNIST_FILES)
+        ds = mnist_dataset("train")
+        assert ds.features.shape == (32, 28, 28, 1)
+        assert ds.labels.shape == (32, 10)
+        assert is_real_mnist_available()
+        # second call must hit the cache even with the server gone
+        monkeypatch.setenv("MNIST_BASE_URL", "http://127.0.0.1:9/")
+        ds2 = mnist_dataset("test")
+        assert ds2.features.shape == (16, 28, 28, 1)
+
+    def test_download_verifies_sha256(self, mnist_server, tmp_path):
+        url = mnist_server + "train-labels-idx1-ubyte.gz"
+        with pytest.raises(ValueError, match="SHA-256"):
+            downloader.download(url, tmp_path / "f.gz", sha256="0" * 64)
+        ok = downloader.download(url, tmp_path / "g.gz")
+        assert ok.exists()
+
+    def test_no_download_env_blocks_network(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DL4J_CACHE_DIR", str(tmp_path / "empty"))
+        monkeypatch.setenv("DL4J_NO_DOWNLOAD", "1")
+        with pytest.raises(RuntimeError, match="forbidden"):
+            downloader.fetch_mnist()
+
+    def test_mnist_fallback_is_loud(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DL4J_CACHE_DIR", str(tmp_path / "empty"))
+        monkeypatch.setenv("DL4J_NO_DOWNLOAD", "1")
+        monkeypatch.delenv("MNIST_DIR", raising=False)
+        with pytest.warns(RuntimeWarning, match="NOT comparable"):
+            ds = mnist_dataset("train")
+        assert ds.features.shape[1:] == (28, 28, 1)
+
+
+class TestCurves:
+    def test_curves_autoencoder_dataset(self):
+        ds = curves_dataset(n=64)
+        assert ds.features.shape == (64, 784)
+        np.testing.assert_array_equal(ds.features, ds.labels)
+        on = ds.features.sum(axis=1)
+        assert (on > 5).all(), "curves should draw >5 pixels each"
+        assert ds.features.max() == 1.0 and ds.features.min() == 0.0
+
+
+class TestLFW:
+    def test_lfw_fallback_is_loud_offline(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DL4J_CACHE_DIR", str(tmp_path))
+        monkeypatch.setenv("DL4J_NO_DOWNLOAD", "1")
+        with pytest.warns(RuntimeWarning):
+            ds = lfw_dataset(num_classes=4)
+        assert ds.features.ndim == 4
+        assert ds.labels.shape[1] == 4
+
+
+@pytest.mark.slow
+class TestMnistQualityGate:
+    """BASELINE.md quality gate: LeNet >= 0.98 test accuracy on REAL MNIST.
+    Runs only where the real dataset is available (cache or MNIST_DIR)."""
+
+    def test_lenet_mnist_accuracy(self):
+        if not is_real_mnist_available():
+            pytest.skip("real MNIST not available (no cache, no MNIST_DIR)")
+        from __graft_entry__ import _lenet_conf
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+
+        train = mnist_dataset("train", download=False)
+        test = mnist_dataset("test", download=False)
+        net = MultiLayerNetwork(_lenet_conf("adam")).init()
+        rng = np.random.default_rng(0)
+        for _ in range(2):
+            order = rng.permutation(len(train.features))
+            for i in range(0, len(order) - 255, 256):
+                idx = order[i:i + 256]
+                net.fit_batch(train.features[idx], train.labels[idx])
+        acc = net.evaluate(test.features, test.labels).accuracy()
+        assert acc >= 0.98, acc

@@ -98,15 +98,25 @@ def fetch_mnist(dest: Optional[Path] = None) -> Path:
         else MNIST_BASE_URLS)
     last_err: Optional[Exception] = None
     for fname in MNIST_FILES:
-        if (dest / fname).exists():
-            continue
+        path = dest / fname
+        if path.exists():
+            try:
+                _check_gzip(path)
+                continue
+            except OSError:
+                path.unlink()  # corrupt cache entry from an earlier run
         for base in bases:
             try:
-                download(base.rstrip("/") + "/" + fname, dest / fname)
-                _check_gzip(dest / fname)
+                download(base.rstrip("/") + "/" + fname, path)
+                _check_gzip(path)
                 break
             except Exception as e:  # noqa: BLE001 — try next mirror
                 last_err = e
+                # A corrupt body (captive portal, error page) must not
+                # poison the cache: the retry and every later call would
+                # reuse it as-is.
+                if path.exists():
+                    path.unlink()
         else:
             raise RuntimeError(
                 f"could not download {fname} from any mirror: {last_err}")

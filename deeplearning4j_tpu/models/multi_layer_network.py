@@ -176,16 +176,20 @@ class MultiLayerNetwork:
         lc = self.conf.layers[-1]
         if str(n - 1) in self.conf.input_preprocessors:
             x = apply_preprocessor(self.conf.input_preprocessors[str(n - 1)], x)
-        if train and lc.dropout and rng is not None:
-            from deeplearning4j_tpu.nn.layers.common import apply_dropout
+        from deeplearning4j_tpu.nn.layers.common import (
+            effective_weights,
+            input_dropout,
+        )
 
-            x = apply_dropout(x, lc.dropout, train,
-                              jax.random.fold_in(rng, n - 1))
+        layer_rng = (jax.random.fold_in(rng, n - 1) if rng is not None
+                     else None)
+        x = input_dropout(lc, x, train, layer_rng)
         p = params[-1]
+        W = effective_weights(lc, p, train, layer_rng)
         if x.ndim == 3:
-            z = jnp.einsum("bti,io->bto", x, p["W"]) + p["b"]
+            z = jnp.einsum("bti,io->bto", x, W) + p["b"]
         else:
-            z = x @ p["W"] + p["b"]
+            z = x @ W + p["b"]
         return z, new_state
 
     def _objective(self, params, state, x, y, rng, mask=None):

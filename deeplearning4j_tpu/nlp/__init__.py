@@ -30,6 +30,19 @@ from deeplearning4j_tpu.nlp.sentence_iterator import (
     LabelAwareSentenceIterator,
     LineSentenceIterator,
 )
+from deeplearning4j_tpu.nlp.document_iterator import (
+    CollectionDocumentIterator,
+    DocumentIterator,
+    FileDocumentIterator,
+    LabelAwareDocumentIterator,
+)
+from deeplearning4j_tpu.nlp.annotators import (
+    SWN3,
+    HmmPosTagger,
+    TreeParser,
+    TreeVectorizer,
+)
+from deeplearning4j_tpu.nlp.word2vec_iterator import Word2VecDataSetIterator
 from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabWord
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 from deeplearning4j_tpu.nlp.glove import Glove
@@ -59,4 +72,8 @@ __all__ = [
     "write_word_vectors", "load_txt_vectors", "write_binary_model",
     "read_binary_model",
     "Tree", "parse_ptb", "right_branching", "compile_trees",
+    "DocumentIterator", "CollectionDocumentIterator",
+    "FileDocumentIterator", "LabelAwareDocumentIterator",
+    "HmmPosTagger", "SWN3", "TreeParser", "TreeVectorizer",
+    "Word2VecDataSetIterator",
 ]

@@ -112,32 +112,27 @@ class Glove(WordVectors):
 
         return step
 
-    def fit(self, sentences) -> "Glove":
-        token_lists = [self.tokenizer.tokenize(s) if isinstance(s, str)
-                       else list(s) for s in sentences]
-        if len(self.vocab) == 0:
-            self.vocab.fit(token_lists)
-        if len(self.vocab) == 0:
-            raise ValueError("empty vocabulary")
-        encoded = [self.vocab.encode(t) for t in token_lists]
-        ii, jj, xx = CoOccurrences(self.window).fit(encoded).to_coo()
-        if len(xx) == 0:
-            raise ValueError("no co-occurrences — corpus too small")
+    def _tokenize_all(self, sentences):
+        return [self.tokenizer.tokenize(s) if isinstance(s, str)
+                else list(s) for s in sentences]
 
+    def _init_params(self) -> None:
         V, D = len(self.vocab), self.vector_length
         rng = np.random.default_rng(self.seed)
-        params = tuple(jnp.asarray(a) for a in (
+        self._params = tuple(jnp.asarray(a) for a in (
             (rng.random((V, D)) - 0.5).astype(np.float32) / D,   # w
             (rng.random((V, D)) - 0.5).astype(np.float32) / D,   # w-context
             np.zeros(V, np.float32),                             # b
             np.zeros(V, np.float32)))                            # b-context
-        adagrad = tuple(jnp.zeros_like(p) for p in params)
-        step = self._build_step()
+        self._adagrad = tuple(jnp.zeros_like(p) for p in self._params)
+        self._step = self._build_step()
 
+    def _train(self, ii, jj, xx, epochs: int, rng) -> List[float]:
         B = self.batch_size
         order = np.arange(len(xx))
-        self.losses: List[float] = []
-        for epoch in range(self.epochs):
+        losses = []
+        params, adagrad = self._params, self._adagrad
+        for _ in range(epochs):
             rng.shuffle(order)
             total = 0.0
             for s in range(0, len(order), B):
@@ -147,15 +142,50 @@ class Glove(WordVectors):
                     valid[len(sel):] = 0.0
                     pad = np.arange(B - len(sel)) % len(order)
                     sel = np.concatenate([sel, order[pad]])
-                params, adagrad, loss = step(
+                params, adagrad, loss = self._step(
                     params, adagrad, jnp.asarray(ii[sel]),
                     jnp.asarray(jj[sel]), jnp.asarray(xx[sel]),
                     jnp.asarray(valid))
                 total += float(loss)
-            self.losses.append(total)
-        w, wc, _, _ = (np.asarray(p) for p in params)
+            losses.append(total)
+        self._params, self._adagrad = params, adagrad
+        self._refresh_syn0()
+        return losses
+
+    def _refresh_syn0(self) -> None:
+        w, wc, _, _ = (np.asarray(p) for p in self._params)
         self.syn0 = (w + wc).astype(np.float32)  # GloVe paper: sum both sets
         self._norms = None
+
+    def fit(self, sentences) -> "Glove":
+        token_lists = self._tokenize_all(sentences)
+        if len(self.vocab) == 0:
+            self.vocab.fit(token_lists)
+        if len(self.vocab) == 0:
+            raise ValueError("empty vocabulary")
+        encoded = [self.vocab.encode(t) for t in token_lists]
+        ii, jj, xx = CoOccurrences(self.window).fit(encoded).to_coo()
+        if len(xx) == 0:
+            raise ValueError("no co-occurrences — corpus too small")
+        self._init_params()
+        self.losses = self._train(ii, jj, xx, self.epochs,
+                                  np.random.default_rng(self.seed))
+        return self
+
+    def partial_fit(self, sentences, epochs: int = 1) -> "Glove":
+        """Continue AdaGrad training on one sentence batch against the
+        CURRENT weights (vocab must already be built) — the incremental
+        unit a distributed GlovePerformer executes per job."""
+        if len(self.vocab) == 0:
+            raise ValueError("build vocab first (call fit once)")
+        if getattr(self, "_params", None) is None:
+            self._init_params()
+        encoded = [self.vocab.encode(t)
+                   for t in self._tokenize_all(sentences)]
+        ii, jj, xx = CoOccurrences(self.window).fit(encoded).to_coo()
+        if len(xx) == 0:
+            return self
+        self._train(ii, jj, xx, epochs, np.random.default_rng(self.seed))
         return self
 
     train = fit

@@ -101,6 +101,22 @@ class MultiLayerConfiguration:
                 kw["weight_init"] = self.conf.weight_init
             if lc.dropout == 0.0 and self.conf.dropout != 0.0:
                 kw["dropout"] = self.conf.dropout
+            # Only dense-family impls honor the weight mask
+            # (dense/output/rnn-output); conv/recurrent/pretrain layers do
+            # input dropout, so propagating the flag there would claim a
+            # regularizer that never runs.
+            from deeplearning4j_tpu.nn.conf.layers import (
+                DenseLayerConf as _D,
+                OutputLayerConf as _O,
+            )
+            if (not lc.use_dropconnect and self.conf.use_dropconnect
+                    and isinstance(lc, (_D, _O))):
+                kw["use_dropconnect"] = True
+            elif lc.use_dropconnect and not isinstance(lc, (_D, _O)):
+                raise ValueError(
+                    f"use_dropconnect is only implemented for dense/output "
+                    f"layers, not {type(lc).__name__} (layer would silently "
+                    f"fall back to input dropout)")
             resolved.append(lc.with_overrides(**kw) if kw else lc)
         object.__setattr__(self, "layers", tuple(resolved))
 

@@ -38,6 +38,9 @@ class LayerConf:
     activation: str = "sigmoid"
     weight_init: str = "xavier"
     dropout: float = 0.0
+    # dropconnect: mask the WEIGHTS (rate = dropout) instead of the input —
+    # reference BaseLayer.java:75-79 / Dropout.applyDropConnect.
+    use_dropconnect: bool = False
     l1: float = 0.0
     l2: float = 0.0
     distribution: Optional[dict] = None

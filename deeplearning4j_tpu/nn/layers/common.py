@@ -37,3 +37,27 @@ def apply_dropout(
 
 def activate(conf: LayerConf, z: jax.Array) -> jax.Array:
     return get_activation(conf.activation)(z)
+
+
+def effective_weights(conf: LayerConf, params: dict, train: bool,
+                      rng: Optional[jax.Array]) -> jax.Array:
+    """W with a dropconnect mask when configured — the reference masks the
+    weight matrix itself at train time (BaseLayer.java:75-79,
+    util/Dropout.applyDropConnect) using the layer's dropout rate."""
+    W = params["W"]
+    if (getattr(conf, "use_dropconnect", False) and train
+            and rng is not None and conf.dropout > 0.0):
+        keep = 1.0 - conf.dropout
+        mask = jax.random.bernoulli(
+            jax.random.fold_in(rng, 0x0DC), keep, W.shape)
+        W = jnp.where(mask, W / keep, 0.0).astype(W.dtype)
+    return W
+
+
+def input_dropout(conf: LayerConf, x: jax.Array, train: bool,
+                  rng: Optional[jax.Array]) -> jax.Array:
+    """Input dropout, skipped when the layer runs dropconnect instead
+    (the rate configures the weight mask in that mode)."""
+    if getattr(conf, "use_dropconnect", False):
+        return x
+    return apply_dropout(x, conf.dropout, train, rng)

@@ -16,7 +16,13 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.layers import LayerImpl, register_layer_impl
-from deeplearning4j_tpu.nn.layers.common import activate, apply_dropout, dense_params
+from deeplearning4j_tpu.nn.layers.common import (
+    activate,
+    apply_dropout,
+    dense_params,
+    effective_weights,
+    input_dropout,
+)
 from deeplearning4j_tpu.ops.initializers import init_weights
 
 
@@ -27,8 +33,8 @@ def dense_init(conf: L.DenseLayerConf, key: jax.Array, dtype=jnp.float32):
 
 
 def dense_apply(conf, params, state, x, *, train=False, rng=None, mask=None):
-    x = apply_dropout(x, conf.dropout, train, rng)
-    z = x @ params["W"] + params["b"]
+    x = input_dropout(conf, x, train, rng)
+    z = x @ effective_weights(conf, params, train, rng) + params["b"]
     return activate(conf, z), state
 
 
@@ -44,8 +50,9 @@ register_layer_impl("outputlayer", LayerImpl(dense_init, dense_apply))
 
 def rnn_output_apply(conf, params, state, x, *, train=False, rng=None, mask=None):
     # x: [batch, time, features] — apply the dense head per timestep.
-    x = apply_dropout(x, conf.dropout, train, rng)
-    z = jnp.einsum("bti,io->bto", x, params["W"]) + params["b"]
+    x = input_dropout(conf, x, train, rng)
+    z = jnp.einsum("bti,io->bto", x,
+                   effective_weights(conf, params, train, rng)) + params["b"]
     return activate(conf, z), state
 
 

@@ -254,8 +254,20 @@ def save_checkpoint_remote(url: str, step: int, params, updater_state=None,
             commit.unlink()
         dest = posixpath.join(base, f"ckpt-{step}")
         store.upload_dir(local, dest)
+        import jax
+
+        if jax.process_count() > 1:
+            # EVERY host's shard must be uploaded before the marker goes
+            # up, or a restarting reader can fetch a checkpoint missing
+            # the slow host's shards.
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                f"remote-ckpt-{step}-uploaded")
         if commit_data is not None:
             store.write_bytes(posixpath.join(dest, "COMMIT"), commit_data)
+        if jax.process_count() > 1:
+            multihost_utils.sync_global_devices(
+                f"remote-ckpt-{step}-committed")
     return posixpath.join(url.rstrip("/"), f"ckpt-{step}")
 
 
@@ -314,35 +326,36 @@ def load_model_remote(url: str):
         return ckpt_lib.load_model(tmp)
 
 
-def open_remote(url: str, cache: Optional[os.PathLike] = None) -> pathlib.Path:
+def open_remote(url: str, cache: Optional[os.PathLike] = None,
+                refresh: bool = False) -> pathlib.Path:
     """Materialize a remote file locally and return its path — the bridge
     that lets csv_dataset/svmlight_dataset read from any store (reference
-    BaseS3DataSetIterator pattern).  Without `cache`, every call fetches
-    fresh into a tmp dir (no staleness); pass `cache` to reuse downloads
-    across calls (keyed by a hash of the full URL, so distinct remote
-    paths never collide)."""
+    BaseS3DataSetIterator pattern).  Downloads land in a download-through
+    cache keyed by a hash of the FULL URL (distinct remote paths never
+    collide; one copy per URL, reused across calls — repeated training
+    loops don't re-fetch or leak temp dirs).  Pass refresh=True to force
+    a re-download when the remote object may have changed."""
     import hashlib
 
     store, path = get_store(url)
     if isinstance(store, LocalStore):
         return pathlib.Path(path)
-    if cache is None:
-        tmp = pathlib.Path(tempfile.mkdtemp(prefix="dl4j_remote_"))
-        dest = tmp / posixpath.basename(path)
-        store.download_file(path, dest)
-        return dest
+    cache = pathlib.Path(cache) if cache else pathlib.Path(
+        tempfile.gettempdir()) / "dl4j_tpu_remote"
     key = hashlib.sha256(url.encode()).hexdigest()[:16]
-    dest = pathlib.Path(cache) / f"{key}-{posixpath.basename(path)}"
-    if not dest.exists():
+    dest = cache / f"{key}-{posixpath.basename(path)}"
+    if refresh or not dest.exists():
         store.download_file(path, dest)
     return dest
 
 
-def remote_dataset(url: str, kind: str = "csv", **kwargs):
+def remote_dataset(url: str, kind: str = "csv",
+                   cache: Optional[os.PathLike] = None,
+                   refresh: bool = False, **kwargs):
     """DataSet from a remote CSV/SVMLight file."""
     from deeplearning4j_tpu.datasets import fetchers
 
-    local = open_remote(url)
+    local = open_remote(url, cache=cache, refresh=refresh)
     if kind == "csv":
         return fetchers.csv_dataset(str(local), **kwargs)
     if kind == "svmlight":

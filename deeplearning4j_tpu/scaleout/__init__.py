@@ -41,6 +41,7 @@ from deeplearning4j_tpu.scaleout.aggregators import (
     ParameterAveragingAggregator,
 )
 from deeplearning4j_tpu.scaleout.performers import (
+    GlovePerformer,
     NetworkPerformer,
     Word2VecPerformer,
 )
@@ -56,7 +57,7 @@ __all__ = [
     "Job", "JobIterator", "WorkerPerformer", "JobAggregator", "WorkRouter",
     "StateTracker", "RemoteStateTracker", "StateTrackerServer",
     "ParameterAveragingAggregator", "DeltaSumAggregator",
-    "NetworkPerformer", "Word2VecPerformer",
+    "NetworkPerformer", "Word2VecPerformer", "GlovePerformer",
     "Master", "Worker", "DistributedRunner",
     "IterativeReduceWorkRouter", "HogwildWorkRouter",
 ]

@@ -88,3 +88,41 @@ class Word2VecPerformer(WorkerPerformer):
         out_name = "syn1" if w2v.negative == 0 else "syn1neg"
         setattr(w2v, out_name, getattr(w2v, out_name) + state[out_name])
         w2v._norms = None
+
+
+class GlovePerformer(WorkerPerformer):
+    """Trains a GloVe replica on a batch of sentences; the result is the
+    DELTA of the (w, w-context, b, b-context) tables vs the round's start,
+    folded by DeltaSumAggregator — the reference's GloveChange collection
+    (`scaleout/perform/models/glove/GlovePerformer.java:229`,
+    GloveChange tracked per-word weight + bias deltas)."""
+
+    KEYS = ("w", "wc", "b", "bc")
+
+    def __init__(self, glove, epochs: int = 1):
+        self.glove = glove
+        self.epochs = epochs
+        if len(glove.vocab) == 0:
+            raise ValueError("glove must have a built vocab + weights "
+                             "(fit on a seed corpus first)")
+        if getattr(glove, "_params", None) is None:
+            glove._init_params()
+
+    def perform(self, job: Job) -> None:
+        g = self.glove
+        start = tuple(np.asarray(p).copy() for p in g._params)
+        g.partial_fit(job.work, epochs=self.epochs)
+        job.result = {k: np.asarray(p) - s for k, p, s
+                      in zip(self.KEYS, g._params, start)}
+        # restore: deltas are applied by the master's aggregate broadcast
+        g._params = tuple(jnp.asarray(s) for s in start)
+        g._refresh_syn0()
+        job.done = True
+
+    def update(self, state: Optional[dict]) -> None:
+        if not state:
+            return
+        g = self.glove
+        g._params = tuple(jnp.asarray(np.asarray(p) + state[k])
+                          for k, p in zip(self.KEYS, g._params))
+        g._refresh_syn0()

@@ -1,0 +1,159 @@
+"""Annotator suite: HMM POS tagger (device Viterbi), SWN3 sentiment
+scorer, raw-text tree parsing, and the raw-corpus -> RNTN pipeline."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.annotators import (
+    SWN3,
+    HmmPosTagger,
+    TreeParser,
+    TreeVectorizer,
+    default_tagger,
+    seed_corpus,
+)
+
+
+class TestPosTagger:
+    def test_tags_seen_sentence(self):
+        tagger = default_tagger()
+        tags = dict(tagger.tag("the quick brown fox".split()))
+        assert tags["the"] == "DET"
+        assert tags["fox"] == "NOUN"
+        assert tags["quick"] == "ADJ"
+
+    def test_verb_noun_disambiguation_by_context(self):
+        """'play' after a plural noun should be a VERB (HMM transition
+        prior does the work, not just the emission table)."""
+        tagger = default_tagger()
+        tags = dict(tagger.tag("the children play".split()))
+        assert tags["play"] == "VERB"
+
+    def test_unknown_word_suffix_guess(self):
+        tagger = default_tagger()
+        # 'jumping...' unseen; -ly adverb suffix seen via quickly/slowly/...
+        tags = dict(tagger.tag("she walks gracefully".split()))
+        assert tags["gracefully"] == "ADV"
+
+    def test_numbers_tagged_num(self):
+        tagger = default_tagger()
+        tags = dict(tagger.tag(["we", "saw", "42", "birds"]))
+        assert tags["42"] == "NUM"
+
+    def test_training_accuracy_on_seed_corpus(self):
+        tagger = HmmPosTagger().fit(seed_corpus())
+        total = correct = 0
+        for sent in seed_corpus():
+            got = tagger.tag([w for w, _ in sent])
+            for (_, want), (_, have) in zip(sent, got):
+                total += 1
+                correct += want == have
+        assert correct / total > 0.9, f"{correct}/{total}"
+
+
+class TestSWN3:
+    def test_positive_negative_words(self):
+        swn = SWN3()
+        assert swn.word_score("good") > 0
+        assert swn.word_score("terrible") < 0
+        assert swn.word_score("the") == 0.0
+
+    def test_rank_weighting_matches_reference_formula(self):
+        """score = sum(s_i/rank_i) / H_n (SWN3.java:108-121)."""
+        swn = SWN3()
+        # 'good#1' in a synset with pos 0.75, neg 0 and no other senses:
+        # 0.75/1 / (1/1) = 0.75
+        assert swn.word_score("good") == pytest.approx(0.75)
+        # 'great#2': rank-2 sense only -> (0.75/2) / (1 + 1/2) = 0.25
+        assert swn.word_score("great") == pytest.approx(0.25)
+
+    def test_negation_flips(self):
+        swn = SWN3()
+        assert swn.score("a good movie") > 0
+        assert swn.score("not a good movie") < 0
+
+    def test_classify_bands(self):
+        swn = SWN3()
+        assert swn.classify("excellent wonderful") == "strong_positive"
+        assert swn.classify("terrible horrible") == "strong_negative"
+        assert swn.classify("the cat sat") == "neutral"
+
+    def test_official_format_file(self, tmp_path):
+        lex = tmp_path / "swn.txt"
+        lex.write_text("# comment line\n"
+                       "a\t100\t0.5\t0.125\tshiny#1\n"
+                       "v\t101\t0\t0.625\tbreak#1 shatter#2\n")
+        swn = SWN3(str(lex))
+        assert swn.word_score("shiny") == pytest.approx(0.375)
+        assert swn.word_score("break") == pytest.approx(-0.625)
+        assert swn.label("shiny", num_classes=5) >= 3
+
+
+class TestTreeParser:
+    def test_parse_produces_binary_tree_over_all_tokens(self):
+        parser = TreeParser()
+        tree = parser.parse("the quick brown fox jumps over the lazy dog")
+        assert tree.tokens() == ["the", "quick", "brown", "fox", "jumps",
+                                 "over", "the", "lazy", "dog"]
+        for node in tree.nodes():
+            assert len(node.children) in (0, 2), "binarize failed"
+
+    def test_sentence_splitting(self):
+        parser = TreeParser()
+        trees = parser.parse_text("I love this movie. It is great!")
+        assert len(trees) == 2
+        assert trees[0].tokens()[0].lower() == "i"
+
+    def test_vectorizer_attaches_sentiment_labels(self):
+        vec = TreeVectorizer(num_classes=5)
+        pos, neg = vec.vectorize(
+            "an excellent wonderful movie. a terrible horrible film.")
+        assert pos.label > neg.label
+
+
+class TestDocumentIterators:
+    def test_file_documents_with_dir_labels(self, tmp_path):
+        from deeplearning4j_tpu.nlp.document_iterator import (
+            LabelAwareDocumentIterator,
+        )
+
+        (tmp_path / "pos").mkdir()
+        (tmp_path / "neg").mkdir()
+        (tmp_path / "pos" / "a.txt").write_text("great movie")
+        (tmp_path / "neg" / "b.txt").write_text("terrible movie")
+        it = LabelAwareDocumentIterator(root=tmp_path, suffix=".txt")
+        pairs = list(it.pairs())
+        assert ("terrible movie", "neg") in pairs
+        assert it.label_set() == ["neg", "pos"]
+        assert len(list(it)) == 2
+
+    def test_collection_iterator(self):
+        from deeplearning4j_tpu.nlp.document_iterator import (
+            CollectionDocumentIterator,
+        )
+
+        it = CollectionDocumentIterator(["d1", "d2"])
+        assert list(it) == ["d1", "d2"]
+        it.reset()
+        assert list(it) == ["d1", "d2"]
+
+
+class TestRawTextToRNTN:
+    def test_rntn_trains_from_raw_sentences(self):
+        """VERDICT r1 'done' bar: raw sentences -> trees -> RNTN training
+        end to end, loss decreasing."""
+        from deeplearning4j_tpu.models.rntn import RNTN
+
+        text = ("i love this excellent movie. "
+                "a wonderful great film. "
+                "this terrible movie wastes time. "
+                "an awful horrible film. "
+                "the happy children laughed. "
+                "the storm destroyed the village.")
+        trees = TreeVectorizer(num_classes=2).vectorize(text)
+        assert len(trees) == 6
+        model = RNTN(d=8, num_classes=2, epochs=25, lr=0.05)
+        model.fit(trees)
+        assert model.losses[-1] < model.losses[0]
+        preds = model.predict(trees)
+        assert len(preds) == 6

@@ -1,0 +1,180 @@
+"""Task-11 parity holes: GlovePerformer delta training,
+Word2VecDataSetIterator window featurization into MultiLayerNetwork,
+and dropconnect weight masks."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayerConf,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayerConf,
+)
+
+CORPUS = [
+    "the cat sat on the mat",
+    "the dog sat on the log",
+    "cats and dogs are animals",
+    "the quick brown fox jumps",
+    "dogs chase cats around the yard",
+    "a cat and a dog played",
+] * 4
+
+
+class TestGlovePerformer:
+    def test_delta_round_trains_embeddings(self):
+        from deeplearning4j_tpu.nlp.glove import Glove
+        from deeplearning4j_tpu.scaleout import (
+            DeltaSumAggregator,
+            GlovePerformer,
+            Job,
+        )
+
+        glove = Glove(vector_length=16, window=5, epochs=2, batch_size=256,
+                      min_word_frequency=1)
+        glove.fit(CORPUS)  # builds vocab + seed weights
+        start_syn0 = glove.syn0.copy()
+
+        a = GlovePerformer(glove)
+        # a second replica sharing vocab (fresh Glove object, same corpus)
+        g2 = Glove(vector_length=16, window=5, epochs=2, batch_size=256,
+                   min_word_frequency=1)
+        g2.fit(CORPUS)
+        b = GlovePerformer(g2)
+
+        agg = DeltaSumAggregator()
+        jobs = [Job(work=CORPUS[:12]), Job(work=CORPUS[12:])]
+        a.perform(jobs[0])
+        b.perform(jobs[1])
+        for j in jobs:
+            assert j.done
+            assert set(j.result) == set(GlovePerformer.KEYS)
+            agg.accumulate(j.result)
+        total = agg.aggregate()
+        a.update(total)
+        assert not np.allclose(a.glove.syn0, start_syn0), \
+            "aggregated deltas did not move the embeddings"
+
+    def test_perform_restores_start_weights(self):
+        """perform() must emit a delta and restore — the master's broadcast
+        is the only thing that moves the replica (Word2VecPerformer
+        contract, applied to GloVe)."""
+        from deeplearning4j_tpu.nlp.glove import Glove
+        from deeplearning4j_tpu.scaleout import GlovePerformer, Job
+
+        glove = Glove(vector_length=8, window=3, epochs=1, batch_size=128)
+        glove.fit(CORPUS)
+        before = tuple(np.asarray(p).copy() for p in glove._params)
+        job = Job(work=CORPUS[:6])
+        GlovePerformer(glove).perform(job)
+        for k, p0 in zip(GlovePerformer.KEYS, before):
+            np.testing.assert_array_equal(np.asarray(
+                dict(zip(GlovePerformer.KEYS, glove._params))[k]), p0)
+        assert any(np.abs(job.result[k]).sum() > 0
+                   for k in GlovePerformer.KEYS)
+
+
+class TestWord2VecDataSetIterator:
+    def _w2v(self):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        w2v = Word2Vec(vector_length=12, window=5, negative=5, epochs=2,
+                       min_word_frequency=1)
+        return w2v.fit(CORPUS)
+
+    def test_window_featurization_shapes(self):
+        from deeplearning4j_tpu.nlp.word2vec_iterator import (
+            Word2VecDataSetIterator,
+        )
+
+        w2v = self._w2v()
+        pairs = [("the cat sat", "animal"), ("the quick fox", "animal"),
+                 ("a b c", "other")]
+        it = Word2VecDataSetIterator(w2v, pairs, ["animal", "other"],
+                                     batch=4, window_size=5)
+        batches = list(it)
+        assert it.input_columns == 5 * 12
+        total = sum(b.num_examples() for b in batches)
+        assert total == 9  # one window per token
+        for b in batches:
+            assert b.features.shape[1] == 60
+            assert b.labels.shape[1] == 2
+
+    def test_feeds_multilayernetwork(self):
+        """End to end: w2v windows -> DataSet batches -> fit -> learn the
+        sentence-label task (reference Word2VecDataSetIterator's purpose)."""
+        from deeplearning4j_tpu.nlp.word2vec_iterator import (
+            Word2VecDataSetIterator,
+        )
+
+        w2v = self._w2v()
+        pairs = ([(s, "pets") for s in CORPUS[:3]]
+                 + [(s, "wild") for s in ("the fox runs far",
+                                          "a wild wolf howls",
+                                          "the bear sleeps")])
+        it = Word2VecDataSetIterator(w2v, pairs, ["pets", "wild"],
+                                     batch=8, window_size=3)
+        net = MultiLayerNetwork(MultiLayerConfiguration(
+            conf=NeuralNetConfiguration(learning_rate=0.05, updater="adam",
+                                        seed=2),
+            layers=(DenseLayerConf(n_in=it.input_columns, n_out=16,
+                                   activation="relu"),
+                    OutputLayerConf(n_in=16, n_out=2)))).init()
+        net.fit(it, epochs=30)
+        ds = it.all_data()
+        assert net.evaluate(ds.features, ds.labels).accuracy() > 0.8
+
+
+class TestDropconnect:
+    def _conf(self, **kw):
+        return MultiLayerConfiguration(
+            conf=NeuralNetConfiguration(learning_rate=0.01, seed=4, **kw),
+            layers=(DenseLayerConf(n_in=6, n_out=32, dropout=0.5),
+                    OutputLayerConf(n_in=32, n_out=2)))
+
+    def test_dropconnect_propagates_and_changes_training_forward(self):
+        conf = self._conf(use_dropconnect=True)
+        assert conf.layers[0].use_dropconnect
+        net = MultiLayerNetwork(conf).init()
+        import jax
+
+        x = np.random.default_rng(0).random((4, 6)).astype(np.float32)
+        train_out, _ = net._forward(net.params, net.state, x, train=True,
+                                    rng=jax.random.PRNGKey(1))
+        eval_out, _ = net._forward(net.params, net.state, x, train=False)
+        assert not np.allclose(np.asarray(train_out), np.asarray(eval_out))
+
+    def test_dropconnect_masks_weights_not_inputs(self):
+        """With dropconnect, a zero-weight column stays zero but inputs are
+        not dropped: feeding all-ones input through identity-ish weights
+        distinguishes weight masking from input masking."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayerConf as D
+        from deeplearning4j_tpu.nn.layers.common import (
+            effective_weights,
+            input_dropout,
+        )
+
+        conf = D(n_in=4, n_out=4, dropout=0.5, use_dropconnect=True)
+        params = {"W": jnp.ones((4, 4)), "b": jnp.zeros(4)}
+        rng = jax.random.PRNGKey(0)
+        W = effective_weights(conf, params, True, rng)
+        w = np.asarray(W)
+        assert ((w == 0) | (np.isclose(w, 2.0))).all(), \
+            "mask should zero or rescale weights"
+        assert (w == 0).any() and (w != 0).any()
+        x = jnp.ones((3, 4))
+        np.testing.assert_array_equal(
+            np.asarray(input_dropout(conf, x, True, rng)), np.asarray(x))
+
+    def test_eval_path_unaffected(self):
+        conf = self._conf(use_dropconnect=True)
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).random((4, 6)).astype(np.float32)
+        a, _ = net._forward(net.params, net.state, x, train=False)
+        b, _ = net._forward(net.params, net.state, x, train=False)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -84,11 +84,16 @@ def _opt_int(v: Optional[str]) -> Optional[int]:
 
 
 def _build_net(model_path: str):
-    """Model argument: either a MultiLayerConfiguration JSON file (train) or
-    a saved-model directory from `runtime.save_model` (test/predict)."""
+    """Model argument: a MultiLayerConfiguration JSON file (train), a saved
+    model directory from `runtime.save_model` (test/predict), or
+    ``zoo:<name>`` for a named zoo architecture (e.g. zoo:alexnet-cifar10)."""
     from deeplearning4j_tpu.models import MultiLayerNetwork
     from deeplearning4j_tpu.runtime import load_model
 
+    if model_path.startswith("zoo:"):
+        from deeplearning4j_tpu.models import get_model
+
+        return MultiLayerNetwork(get_model(model_path[4:])).init()
     p = pathlib.Path(model_path)
     if p.is_dir():
         return load_model(p)

@@ -4,11 +4,12 @@ from deeplearning4j_tpu.datasets.iterators import (
     DataSetIterator,
     MultipleEpochsIterator,
     PrefetchDataSetIterator,
+    ReconstructionDataSetIterator,
     SamplingDataSetIterator,
 )
 
 __all__ = [
     "DataSet", "DataSetIterator", "ArrayDataSetIterator",
     "MultipleEpochsIterator", "SamplingDataSetIterator",
-    "PrefetchDataSetIterator",
+    "PrefetchDataSetIterator", "ReconstructionDataSetIterator",
 ]

@@ -118,6 +118,29 @@ class SamplingDataSetIterator(DataSetIterator):
         return self.batch * self.num_batches
 
 
+class ReconstructionDataSetIterator(DataSetIterator):
+    """Wraps an iterator, setting each batch's labels to its features —
+    the autoencoder/RBM pretraining feed (reference
+    ReconstructionDataSetIterator.java:30, whose ``next()`` does
+    ``ret.setLabels(ret.getFeatureMatrix())``)."""
+
+    def __init__(self, base: DataSetIterator):
+        self.base = base
+
+    def __iter__(self) -> Iterator[DataSet]:
+        for ds in self.base:
+            yield DataSet(ds.features, ds.features, ds.mask)
+
+    def reset(self):
+        self.base.reset()
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+    def total_examples(self):
+        return self.base.total_examples()
+
+
 class PrefetchDataSetIterator(DataSetIterator):
     """Background-thread prefetch over any DataSetIterator.
 

@@ -3,5 +3,17 @@ from deeplearning4j_tpu.models.recursive_autoencoder import (
     RecursiveAutoEncoder,
 )
 from deeplearning4j_tpu.models.rntn import RNTN, RNTNEval
+from deeplearning4j_tpu.models.zoo import (
+    ZOO,
+    alexnet_cifar10,
+    char_lstm,
+    get_model,
+    iris_mlp,
+    lenet_mnist,
+)
 
-__all__ = ["MultiLayerNetwork", "RNTN", "RNTNEval", "RecursiveAutoEncoder"]
+__all__ = [
+    "MultiLayerNetwork", "RNTN", "RNTNEval", "RecursiveAutoEncoder",
+    "ZOO", "get_model", "lenet_mnist", "alexnet_cifar10", "char_lstm",
+    "iris_mlp",
+]

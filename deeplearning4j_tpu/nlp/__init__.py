@@ -60,6 +60,11 @@ from deeplearning4j_tpu.nlp.tree import (
     parse_ptb,
     right_branching,
 )
+from deeplearning4j_tpu.nlp.news import (
+    NewsGroupsDataSetIterator,
+    news_corpus,
+    news_dataset,
+)
 
 __all__ = [
     "DefaultTokenizer", "NGramTokenizer", "DefaultTokenizerFactory",
@@ -76,4 +81,5 @@ __all__ = [
     "FileDocumentIterator", "LabelAwareDocumentIterator",
     "HmmPosTagger", "SWN3", "TreeParser", "TreeVectorizer",
     "Word2VecDataSetIterator",
+    "news_corpus", "news_dataset", "NewsGroupsDataSetIterator",
 ]

@@ -22,15 +22,20 @@ from deeplearning4j_tpu.nlp.vocab import VocabCache
 
 class BaseTextVectorizer:
     def __init__(self, min_word_frequency: int = 1,
-                 tokenizer_factory: Optional[TokenizerFactory] = None):
-        self.vocab = VocabCache(min_word_frequency=min_word_frequency)
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 max_features: Optional[int] = None):
+        self.vocab = VocabCache(min_word_frequency=min_word_frequency,
+                                max_words=max_features)
         self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
         self._doc_freq: Dict[str, int] = {}
         self._idf = np.zeros(0, np.float32)
         self.num_docs = 0
 
     def fit(self, documents: Sequence[str]) -> "BaseTextVectorizer":
-        token_lists = [self.tokenizer.tokenize(d) for d in documents]
+        self._fit_tokens([self.tokenizer.tokenize(d) for d in documents])
+        return self
+
+    def _fit_tokens(self, token_lists: Sequence[Sequence[str]]) -> None:
         self.vocab.fit(token_lists)
         self.num_docs = len(token_lists)
         for toks in token_lists:
@@ -41,7 +46,13 @@ class BaseTextVectorizer:
         for w, df in self._doc_freq.items():
             self._idf[self.vocab.index_of(w)] = math.log(
                 max(self.num_docs, 1) / df)
-        return self
+
+    def fit_transform(self, documents: Sequence[str]) -> np.ndarray:
+        """fit + transform tokenizing each document once (a large corpus is
+        tokenized twice by fit(docs) followed by transform(docs))."""
+        token_lists = [self.tokenizer.tokenize(d) for d in documents]
+        self._fit_tokens(token_lists)
+        return np.stack([self._row(toks) for toks in token_lists])
 
     def _row(self, tokens: Sequence[str]) -> np.ndarray:
         raise NotImplementedError

@@ -34,8 +34,10 @@ class VocabWord:
 class VocabCache:
     """Word→VocabWord store with frequency-ordered contiguous indices."""
 
-    def __init__(self, min_word_frequency: int = 1):
+    def __init__(self, min_word_frequency: int = 1,
+                 max_words: Optional[int] = None):
         self.min_word_frequency = min_word_frequency
+        self.max_words = max_words  # keep only the top-N frequent words
         self.words: Dict[str, VocabWord] = {}
         self._index: List[str] = []
 
@@ -45,6 +47,8 @@ class VocabCache:
         for tokens in sentences:
             counts.update(tokens)
         for word, count in counts.most_common():
+            if self.max_words is not None and len(self._index) >= self.max_words:
+                break
             if count >= self.min_word_frequency:
                 self.add(word, count)
         return self

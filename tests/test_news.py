@@ -1,0 +1,133 @@
+"""News corpus loader + ReconstructionDataSetIterator parity tests."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets import (
+    ArrayDataSetIterator,
+    ReconstructionDataSetIterator,
+)
+from deeplearning4j_tpu.nlp import news_corpus, news_dataset
+from deeplearning4j_tpu.nlp.news import NewsGroupsDataSetIterator
+
+
+def test_news_corpus_from_directory(tmp_path):
+    for label, texts in {"a": ["alpha beta", "beta gamma"],
+                         "b": ["delta epsilon"]}.items():
+        d = tmp_path / label
+        d.mkdir()
+        for i, t in enumerate(texts):
+            (d / f"{i}.txt").write_text(t)
+    docs, doc_labels, labels = news_corpus(tmp_path)
+    assert labels == ["a", "b"]
+    assert sorted(doc_labels) == ["a", "a", "b"]
+    assert "delta epsilon" in docs
+
+
+def test_news_dataset_fallback_is_loud_and_trainable(monkeypatch):
+    # With downloads blocked and no corpus dir, falls back to the bundled
+    # mini corpus (on a networked host the real 20news would download).
+    monkeypatch.setenv("DL4J_NO_DOWNLOAD", "1")
+    ds = news_dataset(tfidf=True)
+    assert ds.features.shape[0] == ds.labels.shape[0] >= 12
+    assert ds.labels.shape[1] == 3
+    # one-hot labels, tf-idf features
+    np.testing.assert_allclose(ds.labels.sum(axis=1), 1.0)
+    assert (ds.features >= 0).all()
+
+
+def test_news_dataset_bow_counts(tmp_path):
+    d = tmp_path / "x"
+    d.mkdir()
+    (d / "0.txt").write_text("cat cat dog")
+    ds = news_dataset(tmp_path, tfidf=False)
+    # Counts: one doc with a 2 and a 1 somewhere.
+    assert sorted(ds.features[0][ds.features[0] > 0].tolist()) == [1.0, 2.0]
+
+
+def test_newsgroups_iterator_batches(monkeypatch):
+    monkeypatch.setenv("DL4J_NO_DOWNLOAD", "1")
+    it = NewsGroupsDataSetIterator(batch=4)
+    batches = list(it)
+    assert all(b.features.shape[0] <= 4 for b in batches)
+    assert sum(b.features.shape[0] for b in batches) == it.total_examples()
+
+
+def test_reconstruction_iterator_sets_labels_to_features():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1, 0, 1]]
+    base = ArrayDataSetIterator(x, y, batch=3, shuffle=False)
+    rec = ReconstructionDataSetIterator(base)
+    for ds in rec:
+        np.testing.assert_array_equal(ds.features, ds.labels)
+    assert rec.batch_size() == 3
+    assert rec.total_examples() == 6
+
+
+def test_image_vectorizer(tmp_path):
+    PIL = __import__("pytest").importorskip("PIL.Image")
+    import numpy as _np
+
+    img = _np.zeros((4, 4), dtype=_np.uint8)
+    img[:2] = 200
+    path = tmp_path / "img.png"
+    PIL.fromarray(img).save(path)
+    from deeplearning4j_tpu.datasets.vectorizer import ImageVectorizer
+
+    ds = ImageVectorizer(path, num_labels=3, label=1).binarize(30).vectorize()
+    assert ds.features.shape == (1, 16)
+    assert set(ds.features[0].tolist()) == {0.0, 1.0}
+    assert ds.labels.tolist() == [[0.0, 1.0, 0.0]]
+    ds2 = ImageVectorizer(path, num_labels=3, label=1).normalize().vectorize()
+    assert 0.0 <= ds2.features.max() <= 1.0
+
+
+def test_news_corpus_interleaves_labels_under_cap(tmp_path):
+    for label in ("aaa", "bbb"):
+        d = tmp_path / label
+        d.mkdir()
+        for i in range(5):
+            (d / f"{i}.txt").write_text(f"{label} doc {i}")
+    _, doc_labels, _ = news_corpus(tmp_path, num_examples=4)
+    assert sorted(doc_labels) == ["aaa", "aaa", "bbb", "bbb"]
+
+
+def test_news_corpus_explicit_missing_root_raises(tmp_path):
+    import pytest as _pytest
+
+    with _pytest.raises(FileNotFoundError):
+        news_corpus(tmp_path / "nope")
+
+
+def test_vectorizer_max_features_caps_vocab():
+    from deeplearning4j_tpu.nlp.vectorizers import CountVectorizer
+
+    docs = ["a a a b b c", "a b c d e f g"]
+    vec = CountVectorizer(max_features=3).fit(docs)
+    assert len(vec.vocab) == 3
+    assert vec.transform(docs).shape == (2, 3)
+
+
+def test_news_fallback_interleaves_under_cap(monkeypatch):
+    monkeypatch.setenv("DL4J_NO_DOWNLOAD", "1")
+    _, doc_labels, labels = news_corpus(num_examples=3)
+    assert sorted(doc_labels) == ["finance", "sport", "tech"]
+    assert labels == ["finance", "sport", "tech"]
+
+
+def test_news_corpus_root_without_label_dirs_raises(tmp_path):
+    (tmp_path / "doc.txt").write_text("not a label dir layout")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="label subdirectories"):
+        news_corpus(tmp_path)
+
+
+def test_fit_transform_matches_fit_then_transform():
+    from deeplearning4j_tpu.nlp.vectorizers import TfidfVectorizer
+
+    docs = ["a b c a", "b c d", "e f a"]
+    one = TfidfVectorizer().fit_transform(docs)
+    two_vec = TfidfVectorizer().fit(docs)
+    import numpy as _np
+
+    _np.testing.assert_allclose(one, two_vec.transform(docs))

@@ -1,0 +1,47 @@
+"""Model zoo: every named architecture builds, trains a step, round-trips."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import (
+    MultiLayerNetwork,
+    alexnet_cifar10,
+    get_model,
+    lenet_mnist,
+)
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+
+def test_get_model_unknown_raises():
+    with pytest.raises(KeyError):
+        get_model("resnet-9000")
+
+
+def test_lenet_shapes_and_step():
+    net = MultiLayerNetwork(lenet_mnist()).init()
+    x = np.random.default_rng(0).random((4, 28, 28, 1), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[[0, 1, 2, 3]]
+    out = np.asarray(net.output(x))
+    assert out.shape == (4, 10)
+    l0 = net.fit_batch(x, y)
+    l1 = net.fit_batch(x, y)
+    assert np.isfinite(l0) and np.isfinite(l1)
+
+
+def test_alexnet_cifar10_shapes_and_step():
+    net = MultiLayerNetwork(alexnet_cifar10()).init()
+    rng = np.random.default_rng(0)
+    x = rng.random((2, 32, 32, 3), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[[3, 7]]
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-4)
+    loss = net.fit_batch(x, y)
+    assert np.isfinite(loss)
+
+
+def test_zoo_configs_serde_roundtrip():
+    for name in ("lenet-mnist", "alexnet-cifar10", "char-lstm", "iris-mlp"):
+        conf = get_model(name)
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        assert back == conf, name

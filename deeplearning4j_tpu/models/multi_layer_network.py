@@ -142,10 +142,22 @@ class MultiLayerNetwork:
 
     # ---- functional forward ----------------------------------------------
 
+    def _cast_floating(self, tree, dtype):
+        """Cast floating leaves to the compute dtype (mixed precision:
+        master params stay float32 in the optimizer; the forward computes
+        in ``compute_dtype`` so the MXU runs at its native bf16 rate)."""
+        if dtype == jnp.float32:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(dtype)
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+            tree)
+
     def _forward(self, params, state, x, *, train: bool, rng=None, mask=None,
                  upto: Optional[int] = None, collect: bool = False):
         """Pure forward fold. Returns (activations_or_final, new_state)."""
         compute_dtype = jnp.dtype(self.conf.conf.compute_dtype)
+        params = self._cast_floating(params, compute_dtype)
         if jnp.issubdtype(x.dtype, jnp.floating):
             x = x.astype(compute_dtype)
         acts = [x]
@@ -184,7 +196,8 @@ class MultiLayerNetwork:
         layer_rng = (jax.random.fold_in(rng, n - 1) if rng is not None
                      else None)
         x = input_dropout(lc, x, train, layer_rng)
-        p = params[-1]
+        p = self._cast_floating(params[-1],
+                                jnp.dtype(self.conf.conf.compute_dtype))
         W = effective_weights(lc, p, train, layer_rng)
         if x.ndim == 3:
             z = jnp.einsum("bti,io->bto", x, W) + p["b"]
@@ -200,11 +213,12 @@ class MultiLayerNetwork:
         if isinstance(lc, (OutputLayerConf, RnnOutputLayerConf)) and fused:
             z, new_state = self._logits_forward(params, state, x, train=True,
                                                 rng=rng, mask=mask)
-            loss = _masked_loss(fused, y, z, mask)
+            # loss always in f32: bf16 softmax/xent loses too much precision
+            loss = _masked_loss(fused, y, z.astype(jnp.float32), mask)
         else:
             out, new_state = self._forward(params, state, x, train=True,
                                            rng=rng, mask=mask)
-            loss = _masked_loss(loss_name, y, out, mask)
+            loss = _masked_loss(loss_name, y, out.astype(jnp.float32), mask)
         # Per-layer L1/L2 (reference per-layer l1/l2 conf overrides; global
         # l1/l2 is folded into the gradient by the updater's pre_apply).
         for lc_i, p_i in zip(self.conf.layers, params):

@@ -29,7 +29,8 @@ from deeplearning4j_tpu.nn.conf import (
 
 
 def lenet_mnist(updater: str = "adam", learning_rate: float = 0.01,
-                seed: int = 0) -> MultiLayerConfiguration:
+                seed: int = 0, compute_dtype: str = "float32"
+                ) -> MultiLayerConfiguration:
     """LeNet-5 for 28x28x1 MNIST (BASELINE.md config #1).
 
     Conv(6,5x5,SAME) -> pool -> Conv(16,5x5) -> pool -> 120 -> 84 -> 10,
@@ -37,7 +38,8 @@ def lenet_mnist(updater: str = "adam", learning_rate: float = 0.01,
     """
     return MultiLayerConfiguration(
         conf=NeuralNetConfiguration(learning_rate=learning_rate,
-                                    updater=updater, seed=seed),
+                                    updater=updater, seed=seed,
+                                    compute_dtype=compute_dtype),
         layers=(
             ConvolutionLayerConf(n_in=1, n_out=6, kernel_size=(5, 5),
                                  padding="SAME"),
@@ -53,8 +55,8 @@ def lenet_mnist(updater: str = "adam", learning_rate: float = 0.01,
 
 
 def alexnet_cifar10(updater: str = "sgd", learning_rate: float = 0.01,
-                    dropout: float = 0.5, seed: int = 0
-                    ) -> MultiLayerConfiguration:
+                    dropout: float = 0.5, seed: int = 0,
+                    compute_dtype: str = "float32") -> MultiLayerConfiguration:
     """AlexNet adapted to 32x32x3 CIFAR-10 (BASELINE.md config #5).
 
     The ImageNet AlexNet's 11x11/stride-4 stem assumes 224x224 inputs; on
@@ -70,7 +72,8 @@ def alexnet_cifar10(updater: str = "sgd", learning_rate: float = 0.01,
     conv = dict(kernel_size=(3, 3), padding="SAME")
     return MultiLayerConfiguration(
         conf=NeuralNetConfiguration(learning_rate=learning_rate,
-                                    updater=updater, seed=seed),
+                                    updater=updater, seed=seed,
+                                    compute_dtype=compute_dtype),
         layers=(
             ConvolutionLayerConf(n_in=3, n_out=64, **conv),
             SubsamplingLayerConf(),

@@ -56,35 +56,46 @@ _FALLBACK = {
 }
 
 
-def _walk_label_dirs(root: Path, num_examples: Optional[int]
-                     ) -> Tuple[List[str], List[str], List[str]]:
-    """(documents, doc_labels, label_names) from one-subdir-per-label.
-
-    Files are taken round-robin across labels so a ``num_examples`` cap
-    yields a class-balanced subset instead of exhausting the
-    alphabetically-first label.
-    """
-    labels = sorted(d.name for d in root.iterdir() if d.is_dir())
-    per_label = {
-        label: iter(sorted(f for f in (root / label).rglob("*")
-                           if f.is_file()))
-        for label in labels
-    }
+def _round_robin(streams: dict, num_examples: Optional[int]
+                 ) -> Tuple[List[str], List[str]]:
+    """Interleave {label: iterator-of-documents} round-robin so a
+    ``num_examples`` cap yields a class-balanced subset instead of
+    exhausting the alphabetically-first label.  Iterators may yield None
+    for unreadable items (skipped without consuming the cap)."""
     docs, doc_labels = [], []
-    live = list(labels)
+    live = sorted(streams)
     while live and (num_examples is None or len(docs) < num_examples):
         for label in list(live):
             if num_examples is not None and len(docs) >= num_examples:
                 break
-            f = next(per_label[label], None)
-            if f is None:
+            doc = next(streams[label], _round_robin)  # sentinel = exhausted
+            if doc is _round_robin:
                 live.remove(label)
                 continue
-            try:
-                docs.append(f.read_text(errors="replace"))
-            except OSError:
+            if doc is None:
                 continue
+            docs.append(doc)
             doc_labels.append(label)
+    return docs, doc_labels
+
+
+def _read_or_none(f: Path) -> Optional[str]:
+    try:
+        return f.read_text(errors="replace")
+    except OSError:
+        return None
+
+
+def _walk_label_dirs(root: Path, num_examples: Optional[int]
+                     ) -> Tuple[List[str], List[str], List[str]]:
+    """(documents, doc_labels, label_names) from one-subdir-per-label."""
+    labels = sorted(d.name for d in root.iterdir() if d.is_dir())
+    streams = {
+        label: (_read_or_none(f)
+                for f in sorted((root / label).rglob("*")) if f.is_file())
+        for label in labels
+    }
+    docs, doc_labels = _round_robin(streams, num_examples)
     return docs, doc_labels, labels
 
 
@@ -137,30 +148,21 @@ def news_corpus(root: Optional[os.PathLike] = None,
         return _walk_label_dirs(Path(root), num_examples)
     env_root = os.environ.get("DL4J_NEWS_DIR")
     if env_root:
-        if Path(env_root).is_dir():
+        if Path(env_root).is_dir() and any(
+                d.is_dir() for d in Path(env_root).iterdir()):
             return _walk_label_dirs(Path(env_root), num_examples)
-        warn_fallback("newsgroups", f"$DL4J_NEWS_DIR={env_root} not a dir",
-                      "downloaded/bundled corpus")
+        warn_fallback(
+            "newsgroups",
+            f"$DL4J_NEWS_DIR={env_root} is not a directory with label "
+            f"subdirectories", "downloaded/bundled corpus")
     fetched = _fetch_newsgroups()
     if fetched is not None:
         return _walk_label_dirs(fetched, num_examples)
     warn_fallback("newsgroups", "no corpus dir and downloads unavailable",
                   "bundled mini news corpus")
-    # Round-robin across labels — same class-balance contract as
-    # _walk_label_dirs when num_examples caps the subset.
-    docs, doc_labels = [], []
-    streams = {label: iter(texts) for label, texts in sorted(_FALLBACK.items())}
-    live = sorted(streams)
-    while live and (num_examples is None or len(docs) < num_examples):
-        for label in list(live):
-            if num_examples is not None and len(docs) >= num_examples:
-                break
-            t = next(streams[label], None)
-            if t is None:
-                live.remove(label)
-                continue
-            docs.append(t)
-            doc_labels.append(label)
+    docs, doc_labels = _round_robin(
+        {label: iter(texts) for label, texts in _FALLBACK.items()},
+        num_examples)
     return docs, doc_labels, sorted(_FALLBACK)
 
 

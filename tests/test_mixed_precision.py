@@ -1,0 +1,67 @@
+"""Mixed precision: bf16 compute, f32 master weights, f32 loss.
+
+The TPU-first dtype policy (`NeuralNetConfiguration.compute_dtype`): the
+forward casts params+activations to the compute dtype (MXU native bf16),
+while the optimizer holds float32 master weights and the loss is always
+computed in float32.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models import MultiLayerNetwork, lenet_mnist
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayerConf,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayerConf,
+)
+
+
+def _iris_conf(dtype):
+    return MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=0.05, updater="adam",
+                                    seed=0, compute_dtype=dtype),
+        layers=(DenseLayerConf(n_in=4, n_out=16, activation="relu"),
+                OutputLayerConf(n_in=16, n_out=3)))
+
+
+def _toy_data(n=96):
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 3, n)
+    x = rng.normal(0, 0.25, (n, 4)).astype(np.float32) + y[:, None]
+    return x, np.eye(3, dtype=np.float32)[y]
+
+
+def test_bf16_master_weights_stay_f32_and_training_converges():
+    net = MultiLayerNetwork(_iris_conf("bfloat16")).init()
+    x, y = _toy_data()
+    losses = [float(net.fit_batch(x, y)) for _ in range(60)]
+    for p in net.params:
+        for v in p.values():
+            assert v.dtype == jnp.float32  # master weights untouched
+    assert losses[-1] < losses[0] * 0.5
+    assert net.evaluate(x, y).accuracy() > 0.9
+
+
+def test_bf16_and_f32_agree_at_init():
+    x, _ = _toy_data(8)
+    f32 = MultiLayerNetwork(_iris_conf("float32")).init()
+    bf16 = MultiLayerNetwork(_iris_conf("bfloat16")).init()
+    # same seed -> same init; outputs agree to bf16 tolerance
+    a = np.asarray(f32.output(x), np.float32)
+    b = np.asarray(bf16.output(x), np.float32)
+    np.testing.assert_allclose(a, b, atol=0.05)
+
+
+def test_bf16_lenet_step_runs():
+    net = MultiLayerNetwork(
+        lenet_mnist(updater="sgd", compute_dtype="bfloat16")).init()
+    rng = np.random.default_rng(0)
+    x = rng.random((4, 28, 28, 1), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[[1, 2, 3, 4]]
+    loss = float(net.fit_batch(x, y))
+    assert np.isfinite(loss)
+    for p in net.params:
+        for v in p.values():
+            assert v.dtype == jnp.float32

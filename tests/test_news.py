@@ -23,10 +23,12 @@ def test_news_corpus_from_directory(tmp_path):
     assert "delta epsilon" in docs
 
 
-def test_news_dataset_fallback_is_loud_and_trainable(monkeypatch):
-    # With downloads blocked and no corpus dir, falls back to the bundled
-    # mini corpus (on a networked host the real 20news would download).
+def test_news_dataset_fallback_is_loud_and_trainable(monkeypatch, tmp_path):
+    # With downloads blocked, an empty cache and no corpus dir, falls back
+    # to the bundled mini corpus (a previously cached real 20news must not
+    # leak in, hence the isolated cache dir).
     monkeypatch.setenv("DL4J_NO_DOWNLOAD", "1")
+    monkeypatch.setenv("DL4J_CACHE_DIR", str(tmp_path))
     ds = news_dataset(tfidf=True)
     assert ds.features.shape[0] == ds.labels.shape[0] >= 12
     assert ds.labels.shape[1] == 3
@@ -44,8 +46,9 @@ def test_news_dataset_bow_counts(tmp_path):
     assert sorted(ds.features[0][ds.features[0] > 0].tolist()) == [1.0, 2.0]
 
 
-def test_newsgroups_iterator_batches(monkeypatch):
+def test_newsgroups_iterator_batches(monkeypatch, tmp_path):
     monkeypatch.setenv("DL4J_NO_DOWNLOAD", "1")
+    monkeypatch.setenv("DL4J_CACHE_DIR", str(tmp_path))
     it = NewsGroupsDataSetIterator(batch=4)
     batches = list(it)
     assert all(b.features.shape[0] <= 4 for b in batches)
@@ -107,8 +110,9 @@ def test_vectorizer_max_features_caps_vocab():
     assert vec.transform(docs).shape == (2, 3)
 
 
-def test_news_fallback_interleaves_under_cap(monkeypatch):
+def test_news_fallback_interleaves_under_cap(monkeypatch, tmp_path):
     monkeypatch.setenv("DL4J_NO_DOWNLOAD", "1")
+    monkeypatch.setenv("DL4J_CACHE_DIR", str(tmp_path))
     _, doc_labels, labels = news_corpus(num_examples=3)
     assert sorted(doc_labels) == ["finance", "sport", "tech"]
     assert labels == ["finance", "sport", "tech"]

@@ -12,8 +12,10 @@ from deeplearning4j_tpu.runtime.checkpoint import (
     ModelSaver,
     load_checkpoint,
     load_model,
+    load_params,
     save_checkpoint,
     save_model,
+    save_params,
 )
 from deeplearning4j_tpu.runtime.storage import (
     RemoteModelSaver,
@@ -27,6 +29,8 @@ from deeplearning4j_tpu.runtime.storage import (
 __all__ = [
     "save_model",
     "load_model",
+    "save_params",
+    "load_params",
     "save_checkpoint",
     "load_checkpoint",
     "ModelSaver",

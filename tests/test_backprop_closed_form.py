@@ -1,0 +1,130 @@
+"""Closed-form backprop expectations at the NETWORK level.
+
+The reference's gold-standard test style (`BackPropMLPTest.java:70`
+``testSingleExampleWeightUpdates``): compute the expected post-backprop
+weights with plain numpy from the chain rule, then assert the framework's
+jitted train step lands on exactly those values. This locks the whole
+stack — forward, fused softmax+xent loss, autodiff, SGD updater — to an
+independent hand derivation rather than a snapshot.
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayerConf,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayerConf,
+)
+
+LR = 0.1
+
+
+def _sigmoid(z):
+    return 1.0 / (1.0 + np.exp(-z))
+
+
+def _softmax(z):
+    e = np.exp(z - z.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def _expected_update(W1, b1, W2, b2, x, y, lr=LR):
+    """One SGD step of sigmoid-MLP + softmax/mcxent by the chain rule."""
+    n = x.shape[0]
+    a1 = _sigmoid(x @ W1 + b1)
+    p = _softmax(a1 @ W2 + b2)
+    dz2 = (p - y) / n                      # mean-over-batch mcxent
+    dW2 = a1.T @ dz2
+    db2 = dz2.sum(axis=0)
+    dz1 = (dz2 @ W2.T) * a1 * (1.0 - a1)   # sigmoid'
+    dW1 = x.T @ dz1
+    db1 = dz1.sum(axis=0)
+    return (W1 - lr * dW1, b1 - lr * db1, W2 - lr * dW2, b2 - lr * db2)
+
+
+def _net(n_in=2, n_hidden=3, n_out=2):
+    conf = MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=LR, updater="sgd", seed=7),
+        layers=(DenseLayerConf(n_in=n_in, n_out=n_hidden,
+                               activation="sigmoid"),
+                OutputLayerConf(n_in=n_hidden, n_out=n_out)))
+    return MultiLayerNetwork(conf).init()
+
+
+def _set_params(net, W1, b1, W2, b2):
+    import jax.numpy as jnp
+
+    p = [dict(pi) for pi in net.params]
+    p[0]["W"], p[0]["b"] = jnp.asarray(W1), jnp.asarray(b1)
+    p[1]["W"], p[1]["b"] = jnp.asarray(W2), jnp.asarray(b2)
+    net.params = p
+
+
+def _get(net, i, k):
+    return np.asarray(net.params[i][k], np.float64)
+
+
+def test_single_example_weight_updates_match_chain_rule():
+    rng = np.random.default_rng(42)
+    W1 = rng.normal(0, 0.5, (2, 3))
+    b1 = rng.normal(0, 0.1, (3,))
+    W2 = rng.normal(0, 0.5, (3, 2))
+    b2 = rng.normal(0, 0.1, (2,))
+    x = np.array([[0.4, -1.2]], np.float32)
+    y = np.array([[0.0, 1.0]], np.float32)
+
+    net = _net()
+    _set_params(net, W1, b1, W2, b2)
+    net.fit_batch(x, y)
+
+    eW1, eb1, eW2, eb2 = _expected_update(W1, b1, W2, b2,
+                                          x.astype(np.float64),
+                                          y.astype(np.float64))
+    np.testing.assert_allclose(_get(net, 0, "W"), eW1, atol=1e-6)
+    np.testing.assert_allclose(_get(net, 0, "b"), eb1, atol=1e-6)
+    np.testing.assert_allclose(_get(net, 1, "W"), eW2, atol=1e-6)
+    np.testing.assert_allclose(_get(net, 1, "b"), eb2, atol=1e-6)
+
+
+def test_minibatch_updates_are_mean_normalized():
+    rng = np.random.default_rng(3)
+    W1 = rng.normal(0, 0.5, (2, 3))
+    b1 = np.zeros(3)
+    W2 = rng.normal(0, 0.5, (3, 2))
+    b2 = np.zeros(2)
+    x = rng.normal(0, 1, (5, 2)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 5)]
+
+    net = _net()
+    _set_params(net, W1, b1, W2, b2)
+    net.fit_batch(x, y)
+
+    eW1, eb1, eW2, eb2 = _expected_update(W1, b1, W2, b2,
+                                          x.astype(np.float64),
+                                          y.astype(np.float64))
+    np.testing.assert_allclose(_get(net, 0, "W"), eW1, atol=1e-6)
+    np.testing.assert_allclose(_get(net, 1, "W"), eW2, atol=1e-6)
+    np.testing.assert_allclose(_get(net, 1, "b"), eb2, atol=1e-6)
+
+
+def test_two_steps_compound_correctly():
+    rng = np.random.default_rng(11)
+    W1 = rng.normal(0, 0.5, (2, 3))
+    b1 = np.zeros(3)
+    W2 = rng.normal(0, 0.5, (3, 2))
+    b2 = np.zeros(2)
+    x = np.array([[1.0, 0.5]], np.float32)
+    y = np.array([[1.0, 0.0]], np.float32)
+
+    net = _net()
+    _set_params(net, W1, b1, W2, b2)
+    net.fit_batch(x, y)
+    net.fit_batch(x, y)
+
+    e = (W1, b1, W2, b2)
+    for _ in range(2):
+        e = _expected_update(*e, x.astype(np.float64), y.astype(np.float64))
+    np.testing.assert_allclose(_get(net, 0, "W"), e[0], atol=1e-5)
+    np.testing.assert_allclose(_get(net, 1, "W"), e[2], atol=1e-5)

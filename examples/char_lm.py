@@ -1,0 +1,66 @@
+"""Character-level LSTM language model (BASELINE.md config #4) with
+temperature sampling.
+
+Run: python examples/char_lm.py [path-to-text] [epochs]
+Defaults to training on this script's own source code.
+"""
+
+import pathlib
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.models import MultiLayerNetwork, char_lstm
+
+
+def batches(ids, vocab, batch=32, seq=64, seed=0):
+    rng = np.random.default_rng(seed)
+    eye = np.eye(vocab, dtype=np.float32)
+    n = len(ids) - seq - 1
+    if n <= 0:
+        raise SystemExit(f"corpus too small: need at least {seq + 2} "
+                         f"characters, got {len(ids)}")
+    while True:
+        start = rng.integers(0, n, batch)
+        x = np.stack([ids[s:s + seq] for s in start])
+        y = np.stack([ids[s + 1:s + seq + 1] for s in start])
+        yield eye[x], eye[y]
+
+
+def sample(net, chars, index, seed_text="def ", length=120, temp=0.8,
+           ctx=64):
+    eye = np.eye(len(chars), dtype=np.float32)
+    ids = [index[c] for c in seed_text if c in index]
+    rng = np.random.default_rng(0)
+    for _ in range(length):
+        # fixed-size left-padded context -> ONE jit compile for the whole
+        # generation loop instead of one per distinct sequence length
+        window = ids[-ctx:]
+        pad = ctx - len(window)
+        x = eye[np.asarray([0] * pad + window)][None]
+        logits = np.log(np.asarray(net.label_probabilities(x))[0, -1] + 1e-9)
+        p = np.exp(logits / temp)
+        ids.append(int(rng.choice(len(chars), p=p / p.sum())))
+    return "".join(chars[i] for i in ids)
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else __file__
+    epochs = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    text = pathlib.Path(path).read_text()
+    chars = sorted(set(text))
+    index = {c: i for i, c in enumerate(chars)}
+    ids = np.asarray([index[c] for c in text])
+    net = MultiLayerNetwork(
+        char_lstm(vocab_size=len(chars), hidden=128)).init()
+    gen = batches(ids, len(chars))
+    for step in range(epochs):
+        x, y = next(gen)
+        loss = net.fit_batch(x, y)
+        if step % 50 == 0:
+            print(f"step {step}: loss {loss:.3f}")
+    print(sample(net, chars, index))
+
+
+if __name__ == "__main__":
+    main()

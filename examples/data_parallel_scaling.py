@@ -1,0 +1,34 @@
+"""SPMD data-parallel training over every visible device.
+
+On a TPU pod slice this rides ICI; to demo on any machine, run with a
+virtual CPU mesh:
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/data_parallel_scaling.py
+"""
+
+import numpy as np
+
+import jax
+
+from deeplearning4j_tpu.models import MultiLayerNetwork, lenet_mnist
+from deeplearning4j_tpu.parallel import DataParallelTrainer
+
+
+def main():
+    n = len(jax.devices())
+    print(f"{n} device(s): {jax.devices()[0].platform}")
+    net = MultiLayerNetwork(lenet_mnist(updater="sgd")).init()
+    trainer = DataParallelTrainer(net)
+    rng = np.random.default_rng(0)
+    b = 32 * n
+    x = rng.random((b, 28, 28, 1), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, b)]
+    for step in range(5):
+        loss = trainer.fit_batch(x, y)
+        print(f"step {step}: loss {float(loss):.4f} "
+              f"(batch {b} sharded over {n} devices, grads pmean'd)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""Train the zoo Iris MLP and print the evaluation report.
+
+Run: python examples/iris_mlp.py
+"""
+
+from deeplearning4j_tpu.datasets.fetchers import iris_dataset
+from deeplearning4j_tpu.models import MultiLayerNetwork, iris_mlp
+
+
+def main():
+    ds = iris_dataset()
+    train, test = ds.split_test_and_train(120, seed=0)
+    net = MultiLayerNetwork(iris_mlp()).init()
+    net.fit((train.features, train.labels), epochs=200)
+    print(net.evaluate(test.features, test.labels).stats())
+
+
+if __name__ == "__main__":
+    main()

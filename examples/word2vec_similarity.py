@@ -1,0 +1,37 @@
+"""Word2Vec on a small corpus: train, query nearest words, save w2v-C text.
+
+Run: python examples/word2vec_similarity.py [corpus.txt]
+Without an argument, trains on a tiny bundled corpus.
+"""
+
+import pathlib
+import sys
+
+from deeplearning4j_tpu.nlp import Word2Vec, write_word_vectors
+
+CORPUS = [
+    "the king rules the kingdom from the castle",
+    "the queen rules the kingdom beside the king",
+    "the farmer works the field near the village",
+    "the baker bakes bread in the village square",
+    "the king and the queen host a feast at the castle",
+    "the farmer brings grain to the baker in the village",
+] * 50
+
+
+def main():
+    if len(sys.argv) > 1:
+        sentences = pathlib.Path(sys.argv[1]).read_text().splitlines()
+    else:
+        sentences = CORPUS
+    w2v = Word2Vec(vector_length=64, window=3, negative=5, epochs=5,
+                   min_word_frequency=2, seed=0)
+    w2v.fit(sentences)
+    for word in ("king", "village"):
+        print(word, "->", w2v.words_nearest(word, 4))
+    write_word_vectors(w2v, "vectors.txt")
+    print("saved vectors.txt (word2vec-C text format)")
+
+
+if __name__ == "__main__":
+    main()

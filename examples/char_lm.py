@@ -1,12 +1,15 @@
 """Character-level LSTM language model (BASELINE.md config #4) with
 temperature sampling.
 
-Run: python examples/char_lm.py [path-to-text] [epochs]
+Run: python examples/char_lm.py [path-to-text] [steps]
+(steps = random-minibatch SGD steps, not passes over the corpus)
 Defaults to training on this script's own source code.
 """
 
 import pathlib
 import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 import numpy as np
 
@@ -38,7 +41,11 @@ def sample(net, chars, index, seed_text="def ", length=120, temp=0.8,
         window = ids[-ctx:]
         pad = ctx - len(window)
         x = eye[np.asarray([0] * pad + window)][None]
-        logits = np.log(np.asarray(net.label_probabilities(x))[0, -1] + 1e-9)
+        # mask out the left padding: the LSTM carries zero state through
+        # masked steps, so conditioning sees only the real characters
+        mask = np.asarray([[0.0] * pad + [1.0] * len(window)], np.float32)
+        probs = np.asarray(net.label_probabilities(x, mask=mask))
+        logits = np.log(probs[0, -1] + 1e-9)
         p = np.exp(logits / temp)
         ids.append(int(rng.choice(len(chars), p=p / p.sum())))
     return "".join(chars[i] for i in ids)
@@ -46,7 +53,7 @@ def sample(net, chars, index, seed_text="def ", length=120, temp=0.8,
 
 def main():
     path = sys.argv[1] if len(sys.argv) > 1 else __file__
-    epochs = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 200
     text = pathlib.Path(path).read_text()
     chars = sorted(set(text))
     index = {c: i for i, c in enumerate(chars)}
@@ -54,7 +61,7 @@ def main():
     net = MultiLayerNetwork(
         char_lstm(vocab_size=len(chars), hidden=128)).init()
     gen = batches(ids, len(chars))
-    for step in range(epochs):
+    for step in range(steps):
         x, y = next(gen)
         loss = net.fit_batch(x, y)
         if step % 50 == 0:

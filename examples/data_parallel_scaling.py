@@ -7,6 +7,11 @@ virtual CPU mesh:
       python examples/data_parallel_scaling.py
 """
 
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
 import numpy as np
 
 import jax

@@ -3,6 +3,11 @@
 Run: python examples/iris_mlp.py
 """
 
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
 from deeplearning4j_tpu.datasets.fetchers import iris_dataset
 from deeplearning4j_tpu.models import MultiLayerNetwork, iris_mlp
 

@@ -8,6 +8,11 @@ Run: python examples/mnist_lenet.py [epochs]
 On TPU, bf16 mixed precision engages the MXU's native rate.
 """
 
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
 import sys
 
 import jax

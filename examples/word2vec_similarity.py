@@ -7,6 +7,8 @@ Without an argument, trains on a tiny bundled corpus.
 import pathlib
 import sys
 
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
 from deeplearning4j_tpu.nlp import Word2Vec, write_word_vectors
 
 CORPUS = [

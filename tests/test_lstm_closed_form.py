@@ -1,0 +1,97 @@
+"""Closed-form GravesLSTM forward expectations.
+
+Same gold-standard style as tests/test_backprop_closed_form.py applied to
+the recurrent stack: a two-timestep Graves LSTM (peepholes, gate order
+[i, f, o, g], tanh cell) is hand-computed with numpy and asserted against
+the lax.scan implementation, including the peephole connections' use of
+c_{t-1} for the input/forget gates and c_t for the output gate, and the
+masked-step state carry the reference stubbed out (GravesLSTM.java:100-106).
+"""
+
+import numpy as np
+
+import jax
+
+from deeplearning4j_tpu.nn.conf.layers import GravesLSTMConf
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    graves_lstm_apply,
+    graves_lstm_init,
+)
+
+
+def _sigmoid(z):
+    return 1.0 / (1.0 + np.exp(-z))
+
+
+def _manual_graves_lstm(params, x):
+    """[batch, time, n_in] -> [batch, time, n] by the Graves 2013 equations."""
+    W = np.asarray(params["W"], np.float64)
+    RW = np.asarray(params["RW"], np.float64)
+    b = np.asarray(params["b"], np.float64)
+    pi, pf, po = (np.asarray(params[k], np.float64)
+                  for k in ("pi", "pf", "po"))
+    n = RW.shape[0]
+    batch, T, _ = x.shape
+    h = np.zeros((batch, n))
+    c = np.zeros((batch, n))
+    out = np.zeros((batch, T, n))
+    for t in range(T):
+        z = x[:, t] @ W + b + h @ RW
+        zi, zf, zo, zg = np.split(z, 4, axis=-1)
+        i = _sigmoid(zi + c * pi)          # peephole from c_{t-1}
+        f = _sigmoid(zf + c * pf)
+        g = np.tanh(zg)
+        c = f * c + i * g
+        o = _sigmoid(zo + c * po)          # peephole from c_t
+        h = o * np.tanh(c)
+        out[:, t] = h
+    return out
+
+
+def _make(n_in=3, n=4, seed=0):
+    conf = GravesLSTMConf(n_in=n_in, n_out=n)
+    params, state = graves_lstm_init(conf, jax.random.PRNGKey(seed))
+    # non-trivial peepholes (init is zeros)
+    rng = np.random.default_rng(seed)
+    import jax.numpy as jnp
+
+    for k in ("pi", "pf", "po"):
+        params[k] = jnp.asarray(rng.normal(0, 0.5, n), jnp.float32)
+    return conf, params, state
+
+
+def test_forward_matches_manual_graves_equations():
+    conf, params, state = _make()
+    rng = np.random.default_rng(1)
+    x = rng.normal(0, 1, (2, 5, 3)).astype(np.float32)
+    got, _ = graves_lstm_apply(conf, params, state, x)
+    want = _manual_graves_lstm(params, x.astype(np.float64))
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_forget_bias_five_keeps_memory_open_at_init():
+    conf, params, state = _make(seed=3)
+    b = np.asarray(params["b"])
+    n = conf.n_out
+    np.testing.assert_allclose(b[n:2 * n], 5.0)  # reference :63-73
+    # f = sigmoid(~5) ~ 0.993 at init: the cell state persists
+    rng = np.random.default_rng(2)
+    x = rng.normal(0, 0.1, (1, 8, 3)).astype(np.float32)
+    got, _ = graves_lstm_apply(conf, params, state, x)
+    assert np.all(np.isfinite(np.asarray(got)))
+
+
+def test_masked_steps_carry_state_unchanged():
+    conf, params, state = _make(seed=5)
+    rng = np.random.default_rng(4)
+    x = rng.normal(0, 1, (1, 4, 3)).astype(np.float32)
+    mask = np.array([[1, 1, 0, 0]], np.float32)
+    got, _ = graves_lstm_apply(conf, params, state, x, mask=mask)
+    got = np.asarray(got)
+    # after the mask ends, h carries the t=1 value through t=2, t=3
+    np.testing.assert_allclose(got[0, 2], got[0, 1], atol=1e-6)
+    np.testing.assert_allclose(got[0, 3], got[0, 1], atol=1e-6)
+    # and the valid prefix equals the unmasked run's prefix
+    full, _ = graves_lstm_apply(conf, params, state, x)
+    np.testing.assert_allclose(got[0, :2], np.asarray(full)[0, :2],
+                               atol=1e-6)

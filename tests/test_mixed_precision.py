@@ -65,3 +65,23 @@ def test_bf16_lenet_step_runs():
     for p in net.params:
         for v in p.values():
             assert v.dtype == jnp.float32
+
+
+def test_bf16_under_data_parallel_mesh():
+    import jax
+
+    if len(jax.devices()) < 2:
+        import pytest
+
+        pytest.skip("needs multi-device mesh")
+    from deeplearning4j_tpu.parallel import DataParallelTrainer
+
+    net = MultiLayerNetwork(_iris_conf("bfloat16")).init()
+    trainer = DataParallelTrainer(net)
+    x, y = _toy_data(n=16 * len(jax.devices()))
+    l0 = float(trainer.fit_batch(x, y))
+    l1 = float(trainer.fit_batch(x, y))
+    assert np.isfinite(l0) and np.isfinite(l1)
+    for p in net.params:
+        for v in p.values():
+            assert v.dtype == jnp.float32

@@ -128,6 +128,44 @@ def _check_gzip(path: Path) -> None:
         f.read(4)
 
 
+CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+CIFAR10_SHA256 = ("6d958be074577803d12ecdefd02955f3"
+                  "9262c83c16fe9348329d7fe0b5c001ce")
+
+
+def fetch_cifar10(dest: Optional[Path] = None) -> Path:
+    """Download-and-cache CIFAR-10 (python pickle batches); returns the
+    extracted `cifar-10-batches-py` directory. Raises when offline."""
+    import shutil
+    import tarfile
+
+    root = Path(dest) if dest else cache_dir("cifar10")
+    extracted = root / "cifar-10-batches-py"
+    if extracted.is_dir():
+        return extracted
+    if not downloads_allowed():
+        raise RuntimeError("CIFAR-10 download forbidden (DL4J_NO_DOWNLOAD)")
+    archive = root / "cifar-10-python.tar.gz"
+    url = os.environ.get("CIFAR10_URL", CIFAR10_URL)
+    download(url, archive, sha256=None if "CIFAR10_URL" in os.environ
+             else CIFAR10_SHA256)
+    tmp = root / ".extract.tmp"
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    try:
+        with tarfile.open(archive) as tf:
+            tf.extractall(tmp, filter="data")
+        (tmp / "cifar-10-batches-py").rename(extracted)
+    except Exception:
+        # A corrupt body (captive portal, error page — possible whenever
+        # CIFAR10_URL bypasses the sha256 pin) must not poison the cache.
+        archive.unlink(missing_ok=True)
+        raise
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return extracted
+
+
 # ---------------------------------------------------------------------------
 # LFW (reference LFWDataSetIterator / LFWLoader)
 # ---------------------------------------------------------------------------

@@ -200,6 +200,67 @@ def _package_mnist(images: np.ndarray, labels: np.ndarray, binarize: bool,
     return DataSet(x.astype(np.float32), one_hot(labels, 10))
 
 
+def cifar10_dataset(split: str = "train",
+                    download: Optional[bool] = None) -> DataSet:
+    """CIFAR-10 as NHWC [N,32,32,3] float32 in [0,1] (BASELINE.md config #5's
+    dataset). Resolution: $CIFAR10_DIR with the python batches > cache >
+    download > LOUD synthetic fallback."""
+    import pickle
+
+    from deeplearning4j_tpu.datasets import downloader
+
+    def load_dir(d: Path) -> Optional[DataSet]:
+        names = ([f"data_batch_{i}" for i in range(1, 6)]
+                 if split == "train" else ["test_batch"])
+        if not all((d / n).exists() for n in names):
+            return None
+        xs, ys = [], []
+        for n in names:
+            with open(d / n, "rb") as f:
+                batch = pickle.load(f, encoding="bytes")
+            xs.append(np.asarray(batch[b"data"], np.uint8))
+            ys.extend(batch[b"labels"])
+        x = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        x = np.transpose(x, (0, 2, 3, 1)).astype(np.float32) / 255.0  # NHWC
+        return DataSet(x, one_hot(np.asarray(ys), 10))
+
+    env_dir = os.environ.get("CIFAR10_DIR")
+    candidates = [Path(env_dir)] if env_dir else []
+    candidates.append(downloader.cache_dir("cifar10") / "cifar-10-batches-py")
+    for d in candidates:
+        ds = load_dir(d)
+        if ds is not None:
+            return ds
+    if download is not False and downloader.downloads_allowed():
+        try:
+            ds = load_dir(downloader.fetch_cifar10())
+            if ds is not None:
+                return ds
+            downloader.warn_fallback("cifar10_dataset",
+                                     "downloaded archive missing batches",
+                                     "synthetic Gaussian blobs")
+        except Exception as e:  # noqa: BLE001 — fall back loudly below
+            downloader.warn_fallback("cifar10_dataset",
+                                     f"download failed ({e})",
+                                     "synthetic Gaussian blobs")
+    else:
+        downloader.warn_fallback("cifar10_dataset",
+                                 "no cached CIFAR-10 and downloads disabled",
+                                 "synthetic Gaussian blobs")
+    # small synthetic set: smoke/throughput only, don't burn ~2 GB of RAM
+    return synthetic_cifar10(6000 if split == "train" else 1000)
+
+
+def synthetic_cifar10(n: int, seed: int = 0) -> DataSet:
+    """Class-dependent color blobs at CIFAR shapes (throughput/smoke only)."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, n)
+    centers = rng.random((10, 32, 32, 3)).astype(np.float32)
+    x = centers[labels] * 0.5 + rng.random(
+        (n, 32, 32, 3)).astype(np.float32) * 0.5
+    return DataSet(x, one_hot(labels, 10))
+
+
 def csv_dataset(path: str, label_col: int = -1, num_classes: Optional[int] = None,
                 skip_header: bool = False, delimiter: str = ",") -> DataSet:
     """CSV → DataSet (reference CSVDataSetIterator / Canova CSV reader).

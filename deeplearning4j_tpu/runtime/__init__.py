@@ -17,6 +17,11 @@ from deeplearning4j_tpu.runtime.checkpoint import (
     save_model,
     save_params,
 )
+from deeplearning4j_tpu.runtime.determinism import (
+    NondeterminismError,
+    check_network_determinism,
+    check_step_determinism,
+)
 from deeplearning4j_tpu.runtime.storage import (
     RemoteModelSaver,
     get_store,
@@ -42,4 +47,7 @@ __all__ = [
     "RemoteModelSaver",
     "load_model_remote",
     "remote_dataset",
+    "check_step_determinism",
+    "check_network_determinism",
+    "NondeterminismError",
 ]

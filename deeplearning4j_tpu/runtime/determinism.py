@@ -1,0 +1,77 @@
+"""Determinism checking — the TPU-era answer to "race detection".
+
+The reference had no sanitizers; its Word2Vec updates were deliberately
+racy Hogwild (SURVEY §5). This framework's claim is the opposite — every
+training path is deterministic given a seed — and this module makes that
+claim checkable: run the same step twice from identical state and assert
+bit-identical parameters.
+
+Use in tests or as a pre-flight on new hardware/backends (XLA on a new
+chip generation can introduce nondeterministic reductions; this catches
+it in seconds).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import numpy as np
+
+
+class NondeterminismError(AssertionError):
+    pass
+
+
+def _snapshot(tree):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def check_step_determinism(make_state: Callable[[], object],
+                           step: Callable[[object], object],
+                           steps: int = 3,
+                           atol: float = 0.0,
+                           extract: Callable[[object], object] = lambda s: s
+                           ) -> None:
+    """Run `steps` steps twice from two fresh `make_state()` states and
+    assert the `extract`ed result pytrees match to `atol` (0.0 =
+    bit-identical).  Raises NondeterminismError naming the first
+    mismatching leaf.
+    """
+    def run():
+        s = make_state()
+        for _ in range(steps):
+            s = step(s)
+        return extract(s)
+
+    a, b = _snapshot(run()), _snapshot(run())
+    for i, (x, y) in enumerate(zip(a, b)):
+        if x.shape != y.shape:
+            raise NondeterminismError(
+                f"leaf {i}: shape {x.shape} vs {y.shape}")
+        if atol == 0.0:
+            same = np.array_equal(x, y)
+        else:
+            same = np.allclose(x, y, atol=atol, rtol=0)
+        if not same:
+            diff = float(np.max(np.abs(
+                x.astype(np.float64) - y.astype(np.float64))))
+            raise NondeterminismError(
+                f"leaf {i}: max abs diff {diff:g} after {steps} steps "
+                f"(atol={atol})")
+
+
+def check_network_determinism(conf, x, y, steps: int = 3,
+                              atol: float = 0.0) -> None:
+    """Convenience wrapper: train a fresh MultiLayerNetwork twice on the
+    same batch (the conf's seed drives init and dropout) and assert
+    identical parameters."""
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+
+    def step(net):
+        net.fit_batch(x, y)
+        return net
+
+    check_step_determinism(
+        lambda: MultiLayerNetwork(conf).init(), step, steps=steps,
+        atol=atol, extract=lambda net: net.params)

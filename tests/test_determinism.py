@@ -1,0 +1,61 @@
+"""Determinism checker + CIFAR-10 fetcher tests."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import iris_mlp
+from deeplearning4j_tpu.runtime import (
+    NondeterminismError,
+    check_network_determinism,
+    check_step_determinism,
+)
+
+
+def test_network_training_is_deterministic():
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    check_network_determinism(iris_mlp(), x, y, steps=3)
+
+
+def test_checker_catches_injected_nondeterminism():
+    import itertools
+
+    counter = itertools.count()
+
+    def step(s):
+        return s + next(counter) * 1e-3
+
+    with pytest.raises(NondeterminismError):
+        check_step_determinism(lambda: np.zeros(4), step, steps=2)
+
+
+def test_cifar10_fallback_is_loud_and_shaped(monkeypatch, tmp_path):
+    monkeypatch.setenv("DL4J_NO_DOWNLOAD", "1")
+    monkeypatch.setenv("DL4J_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("CIFAR10_DIR", raising=False)
+    from deeplearning4j_tpu.datasets.fetchers import cifar10_dataset
+
+    with pytest.warns(RuntimeWarning):
+        ds = cifar10_dataset("test")
+    assert ds.features.shape == (1000, 32, 32, 3)
+    assert ds.labels.shape == (1000, 10)
+
+
+def test_cifar10_loads_pickle_batches_from_env_dir(monkeypatch, tmp_path):
+    rng = np.random.default_rng(0)
+    for name, n in [("data_batch_%d" % i, 20) for i in range(1, 6)] + [
+            ("test_batch", 10)]:
+        batch = {b"data": rng.integers(0, 256, (n, 3072), dtype=np.uint8),
+                 b"labels": rng.integers(0, 10, n).tolist()}
+        (tmp_path / name).write_bytes(pickle.dumps(batch))
+    monkeypatch.setenv("CIFAR10_DIR", str(tmp_path))
+    from deeplearning4j_tpu.datasets.fetchers import cifar10_dataset
+
+    tr = cifar10_dataset("train")
+    te = cifar10_dataset("test")
+    assert tr.features.shape == (100, 32, 32, 3)
+    assert te.features.shape == (10, 32, 32, 3)
+    assert 0.0 <= tr.features.min() and tr.features.max() <= 1.0

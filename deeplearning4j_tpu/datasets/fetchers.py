@@ -186,8 +186,9 @@ def synthetic_mnist(n: int = 6000, binarize: bool = False,
     """Class-dependent Gaussian blobs at MNIST shapes — enough for throughput
     benchmarks and smoke tests when no real data exists."""
     rng = np.random.default_rng(seed)
+    centers = np.random.default_rng(12345).random((10, 28, 28)).astype(
+        np.float32)
     labels = rng.integers(0, 10, n)
-    centers = rng.random((10, 28, 28)).astype(np.float32)
     images = centers[labels] * 0.5 + rng.random((n, 28, 28)).astype(np.float32) * 0.5
     return _package_mnist(images, labels, binarize, flatten)
 
@@ -252,10 +253,13 @@ def cifar10_dataset(split: str = "train",
 
 
 def synthetic_cifar10(n: int, seed: int = 0) -> DataSet:
-    """Class-dependent color blobs at CIFAR shapes (throughput/smoke only)."""
+    """Class-dependent color blobs at CIFAR shapes (throughput/smoke only).
+    Centers come from a dedicated rng so train/test splits of different
+    sizes share the same class structure."""
     rng = np.random.default_rng(seed)
+    centers = np.random.default_rng(12345).random(
+        (10, 32, 32, 3)).astype(np.float32)
     labels = rng.integers(0, 10, n)
-    centers = rng.random((10, 32, 32, 3)).astype(np.float32)
     x = centers[labels] * 0.5 + rng.random(
         (n, 32, 32, 3)).astype(np.float32) * 0.5
     return DataSet(x, one_hot(labels, 10))

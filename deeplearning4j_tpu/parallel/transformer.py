@@ -46,6 +46,7 @@ class TransformerConfig:
     n_experts: int = 0          # 0 = dense MLP; >0 = top-1 MoE
     max_len: int = 512
     dtype: str = "float32"
+    attn_bias: bool = False     # GPT-2-style q/k/v/o projection biases
 
     @property
     def head_dim(self) -> int:
@@ -84,6 +85,10 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:
                 "wo": dense(next(keys), (h, dh, d), d),
             },
         }
+        if cfg.attn_bias:
+            layer["attn"].update(
+                bq=jnp.zeros((h, dh), dt), bk=jnp.zeros((h, dh), dt),
+                bv=jnp.zeros((h, dh), dt), bo=jnp.zeros((d,), dt))
         if cfg.n_experts:
             e = cfg.n_experts
             layer["moe"] = {
@@ -121,6 +126,9 @@ def param_specs(cfg: TransformerConfig, model_axis: Optional[str]) -> dict:
         "attn": {"wq": P(None, t, None), "wk": P(None, t, None),
                  "wv": P(None, t, None), "wo": P(t, None, None)},
     }
+    if cfg.attn_bias:
+        layer_spec["attn"].update(bq=P(t, None), bk=P(t, None),
+                                  bv=P(t, None), bo=P())
     if cfg.n_experts:
         layer_spec["moe"] = {"gate": P(), "w1": P(t, None, None),
                              "b1": P(t, None), "w2": P(t, None, None),
@@ -149,6 +157,8 @@ def _attn(p, x, mesh: Optional[Mesh], axes: MeshAxes, causal: bool):
     q = jnp.einsum("bsd,dhk->bshk", x, p["wq"])
     k = jnp.einsum("bsd,dhk->bshk", x, p["wk"])
     v = jnp.einsum("bsd,dhk->bshk", x, p["wv"])
+    if "bq" in p:  # optional projection biases (GPT-2-style checkpoints)
+        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
     if mesh is None:
         from deeplearning4j_tpu.parallel import kernels
 
@@ -172,7 +182,10 @@ def _attn(p, x, mesh: Optional[Mesh], axes: MeshAxes, causal: bool):
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_rep=False)
         o = ring(q, k, v)
-    return jnp.einsum("bshk,hkd->bsd", o, p["wo"])
+    out = jnp.einsum("bshk,hkd->bsd", o, p["wo"])
+    if "bo" in p:
+        out = out + p["bo"]
+    return out
 
 
 def _mlp(p, x):

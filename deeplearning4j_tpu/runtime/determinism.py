@@ -45,6 +45,9 @@ def check_step_determinism(make_state: Callable[[], object],
         return extract(s)
 
     a, b = _snapshot(run()), _snapshot(run())
+    if len(a) != len(b):
+        raise NondeterminismError(
+            f"leaf count differs between runs: {len(a)} vs {len(b)}")
     for i, (x, y) in enumerate(zip(a, b)):
         if x.shape != y.shape:
             raise NondeterminismError(

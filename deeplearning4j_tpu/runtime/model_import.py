@@ -184,3 +184,107 @@ def import_torch_sequential(model, learning_rate: float = 0.01,
                     f"{val.shape} != expected {net.params[li][key].shape}")
             net.params[li][key] = val
     return net, report
+
+
+# ---------------------------------------------------------------------------
+# HuggingFace GPT-2 -> TransformerLM (parallel/transformer.py)
+# ---------------------------------------------------------------------------
+
+def import_hf_gpt2(model):
+    """Import a HuggingFace ``GPT2LMHeadModel`` into this framework's
+    TransformerLM: returns ``(TransformerConfig, params)`` usable with
+    ``parallel.transformer.apply`` — including under a sharded mesh, since
+    the imported tree has the same structure ``param_specs`` shards.
+
+    Fills the role the reference planned for its empty `dl4j-caffe` import
+    module, aimed at the model family this framework is designed around.
+    Architecture mapping (GPT-2 is pre-LN with learned positions, tanh-gelu
+    and a head tied to the token embedding — all matching this
+    TransformerLM; the only extension needed is attention projection
+    biases, carried as optional bq/bk/bv/bo):
+
+    - wte/wpe            -> embed [V,d] / pos [P,d]; head = wte.T (tied)
+    - h[i].ln_1/ln_2     -> layers[i].ln1/ln2 {scale, bias}
+    - h[i].attn.c_attn   -> wq/wk/wv [d,h,k] + bq/bk/bv [h,k]
+      (HF Conv1D stores [in, out] with y = x @ W + b; the 3d output axis
+      splits q,k,v and reshapes head-major, matching HF's split_heads)
+    - h[i].attn.c_proj   -> wo [h,k,d] + bo [d]
+    - h[i].mlp.c_fc/c_proj -> w1 [d,f]+b1 / w2 [f,d]+b2
+    - ln_f               -> final layer norm
+    """
+    import numpy as _np
+
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel.transformer import TransformerConfig
+
+    hf = model.config
+    if getattr(hf, "activation_function", "gelu_new") not in (
+            "gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(
+            f"unsupported activation {hf.activation_function!r}: the "
+            f"TransformerLM uses tanh-approximated gelu (gelu_new)")
+    eps = getattr(hf, "layer_norm_epsilon", 1e-5)
+    if abs(eps - 1e-5) > 1e-12:
+        raise ValueError(f"unsupported layer_norm_epsilon {eps}: the "
+                         f"TransformerLM hard-codes 1e-5")
+    for flag in ("scale_attn_by_inverse_layer_idx",
+                 "reorder_and_upcast_attn", "scale_attn_weights"):
+        v = getattr(hf, flag, None)
+        ok = True if flag == "scale_attn_weights" else False
+        if v is not None and v is not ok:
+            raise ValueError(f"unsupported GPT-2 variant: {flag}={v} "
+                             f"changes attention math vs this TransformerLM")
+    d, h = hf.n_embd, hf.n_head
+    k = d // h
+    f = hf.n_inner if hf.n_inner is not None else 4 * d
+    cfg = TransformerConfig(vocab_size=hf.vocab_size, d_model=d, n_heads=h,
+                            n_layers=hf.n_layer, d_ff=f,
+                            max_len=hf.n_positions, attn_bias=True)
+    sd = {name: _np.asarray(t.detach().cpu().float().numpy())
+          for name, t in model.state_dict().items()}
+    prefix = "transformer." if any(s.startswith("transformer.")
+                                   for s in sd) else ""
+
+    def g(name):
+        return sd[prefix + name]
+
+    wte = g("wte.weight")
+    layers = []
+    for i in range(hf.n_layer):
+        p = f"h.{i}."
+        ca_w, ca_b = g(p + "attn.c_attn.weight"), g(p + "attn.c_attn.bias")
+        wq, wk, wv = _np.split(ca_w, 3, axis=1)
+        bq, bk, bv = _np.split(ca_b, 3)
+        cp_w, cp_b = g(p + "attn.c_proj.weight"), g(p + "attn.c_proj.bias")
+        layers.append({
+            "ln1": {"scale": jnp.asarray(g(p + "ln_1.weight")),
+                    "bias": jnp.asarray(g(p + "ln_1.bias"))},
+            "ln2": {"scale": jnp.asarray(g(p + "ln_2.weight")),
+                    "bias": jnp.asarray(g(p + "ln_2.bias"))},
+            "attn": {
+                "wq": jnp.asarray(wq.reshape(d, h, k)),
+                "wk": jnp.asarray(wk.reshape(d, h, k)),
+                "wv": jnp.asarray(wv.reshape(d, h, k)),
+                "bq": jnp.asarray(bq.reshape(h, k)),
+                "bk": jnp.asarray(bk.reshape(h, k)),
+                "bv": jnp.asarray(bv.reshape(h, k)),
+                "wo": jnp.asarray(cp_w.reshape(h, k, d)),
+                "bo": jnp.asarray(cp_b),
+            },
+            "mlp": {
+                "w1": jnp.asarray(g(p + "mlp.c_fc.weight")),
+                "b1": jnp.asarray(g(p + "mlp.c_fc.bias")),
+                "w2": jnp.asarray(g(p + "mlp.c_proj.weight")),
+                "b2": jnp.asarray(g(p + "mlp.c_proj.bias")),
+            },
+        })
+    params = {
+        "embed": jnp.asarray(wte),
+        "pos": jnp.asarray(g("wpe.weight")),
+        "layers": layers,
+        "ln_f": {"scale": jnp.asarray(g("ln_f.weight")),
+                 "bias": jnp.asarray(g("ln_f.bias"))},
+        "head": jnp.asarray(wte.T),  # GPT-2 ties head to wte
+    }
+    return cfg, params

@@ -1,4 +1,5 @@
-from deeplearning4j_tpu.parallel.mesh import make_mesh
 from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
+from deeplearning4j_tpu.parallel.generation import generate
+from deeplearning4j_tpu.parallel.mesh import make_mesh
 
-__all__ = ["make_mesh", "DataParallelTrainer"]
+__all__ = ["make_mesh", "DataParallelTrainer", "generate"]

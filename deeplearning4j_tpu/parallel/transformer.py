@@ -151,14 +151,29 @@ def _layer_norm(p, x, eps=1e-5):
     return (x - mu) / jnp.sqrt(var + eps) * p["scale"] + p["bias"]
 
 
-def _attn(p, x, mesh: Optional[Mesh], axes: MeshAxes, causal: bool):
-    """x:[B,S,d] full arrays. Ring attention under shard_map when a mesh is
-    given (seq axis shards S); plain attention otherwise."""
+def qkv_proj(p, x):
+    """[B,S,d] -> q,k,v [B,S,H,K] incl. optional GPT-2-style biases.
+    Shared by the training forward and the KV-cached decode path."""
     q = jnp.einsum("bsd,dhk->bshk", x, p["wq"])
     k = jnp.einsum("bsd,dhk->bshk", x, p["wk"])
     v = jnp.einsum("bsd,dhk->bshk", x, p["wv"])
-    if "bq" in p:  # optional projection biases (GPT-2-style checkpoints)
+    if "bq" in p:
         q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+    return q, k, v
+
+
+def out_proj(p, o):
+    """[B,S,H,K] attention output -> [B,S,d] incl. optional bias."""
+    out = jnp.einsum("bshk,hkd->bsd", o, p["wo"])
+    if "bo" in p:
+        out = out + p["bo"]
+    return out
+
+
+def _attn(p, x, mesh: Optional[Mesh], axes: MeshAxes, causal: bool):
+    """x:[B,S,d] full arrays. Ring attention under shard_map when a mesh is
+    given (seq axis shards S); plain attention otherwise."""
+    q, k, v = qkv_proj(p, x)
     if mesh is None:
         from deeplearning4j_tpu.parallel import kernels
 
@@ -182,10 +197,7 @@ def _attn(p, x, mesh: Optional[Mesh], axes: MeshAxes, causal: bool):
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_rep=False)
         o = ring(q, k, v)
-    out = jnp.einsum("bshk,hkd->bsd", o, p["wo"])
-    if "bo" in p:
-        out = out + p["bo"]
-    return out
+    return out_proj(p, o)
 
 
 def _mlp(p, x):

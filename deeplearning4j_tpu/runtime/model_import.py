@@ -285,6 +285,8 @@ def import_hf_gpt2(model):
         "layers": layers,
         "ln_f": {"scale": jnp.asarray(g("ln_f.weight")),
                  "bias": jnp.asarray(g("ln_f.bias"))},
-        "head": jnp.asarray(wte.T),  # GPT-2 ties head to wte
+        # honor untied heads: lm_head.weight is the same tensor as wte
+        # for tied checkpoints and a distinct matrix otherwise
+        "head": jnp.asarray(sd.get("lm_head.weight", wte).T),
     }
     return cfg, params

@@ -1,0 +1,90 @@
+"""KV-cached decoding: cache path == full recompute; HF generate parity."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.parallel import transformer as tfm
+from deeplearning4j_tpu.parallel.generation import (
+    decode_step,
+    generate,
+    init_cache,
+)
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=61, d_model=32, n_heads=4, n_layers=2,
+                d_ff=64, max_len=32)
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+def test_decode_step_matches_full_forward():
+    cfg = _cfg()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 10)).astype(np.int32)
+    full = np.asarray(tfm.apply(cfg, params, tokens))      # [B,S,V]
+    cache = init_cache(cfg, 2)
+    for t in range(tokens.shape[1]):
+        logits, cache = decode_step(cfg, params, cache, tokens[:, t])
+        np.testing.assert_allclose(np.asarray(logits), full[:, t],
+                                   atol=2e-4)
+
+
+def test_greedy_generate_matches_argmax_recompute():
+    cfg = _cfg()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, cfg.vocab_size, (1, 4)).astype(np.int32)
+    out = np.asarray(generate(cfg, params, prompt, max_new_tokens=6))
+    # reference: naive recompute-per-token greedy loop
+    ids = prompt[0].tolist()
+    for _ in range(6):
+        logits = np.asarray(tfm.apply(
+            cfg, params, np.asarray([ids], np.int32)))
+        ids.append(int(logits[0, -1].argmax()))
+    assert out[0].tolist() == ids
+
+
+def test_sampled_generation_is_seeded_and_in_vocab():
+    cfg = _cfg()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(2))
+    prompt = np.zeros((2, 3), np.int32)
+    a = np.asarray(generate(cfg, params, prompt, 8, temperature=0.9,
+                            rng=jax.random.PRNGKey(7)))
+    b = np.asarray(generate(cfg, params, prompt, 8, temperature=0.9,
+                            rng=jax.random.PRNGKey(7)))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (2, 11)
+    assert (a >= 0).all() and (a < cfg.vocab_size).all()
+    with pytest.raises(ValueError, match="rng"):
+        generate(cfg, params, prompt, 4, temperature=0.5)
+
+
+def test_generate_respects_max_len():
+    cfg = _cfg(max_len=8)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(3))
+    with pytest.raises(ValueError, match="max_len"):
+        generate(cfg, params, np.zeros((1, 5), np.int32), 4)
+
+
+def test_gpt2_cached_generation_matches_hf():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from deeplearning4j_tpu.runtime.model_import import import_hf_gpt2
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=97, n_positions=32, n_embd=32, n_layer=2, n_head=4)
+    torch.manual_seed(0)
+    model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    cfg, params = import_hf_gpt2(model)
+    prompt = [[5, 17, 3]]
+    ours = np.asarray(generate(cfg, params, np.asarray(prompt, np.int32),
+                               max_new_tokens=8))[0].tolist()
+    with torch.no_grad():
+        want = model.generate(torch.tensor(prompt), max_length=11,
+                              do_sample=False,
+                              pad_token_id=0)[0].tolist()
+    assert ours == want

@@ -62,8 +62,8 @@ def test_unsupported_activation_rejected():
 def test_imported_params_shard_on_mesh():
     import jax
 
-    if len(jax.devices()) < 4:
-        pytest.skip("needs >=4 devices")
+    if len(jax.devices()) != 8:
+        pytest.skip("needs exactly 8 devices for the (2,4) mesh")
     from deeplearning4j_tpu.parallel import make_mesh
     from deeplearning4j_tpu.parallel.hybrid import place_params
     from deeplearning4j_tpu.parallel import transformer as tfm_mod

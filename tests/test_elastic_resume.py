@@ -32,14 +32,18 @@ def test_resume_on_smaller_mesh(tmp_path):
     big = DataParallelTrainer(net, mesh=make_mesh((8,), ("data",)))
     for _ in range(5):
         big.fit_batch(x, y)
-    save_checkpoint(tmp_path, step=5, params=net.params)
+    save_checkpoint(tmp_path, step=5, params=net.params,
+                    updater_state=net.updater_state)
     loss_before = float(big.fit_batch(x, y))
 
     # "failure": restart on half the devices from the checkpoint
     net2 = MultiLayerNetwork(iris_mlp()).init()
-    step, params, _, _ = load_checkpoint(tmp_path, net2.params)
+    step, params, upd, _ = load_checkpoint(
+        tmp_path, net2.params, updater_like=net2.updater_state)
     assert step == 5
+    assert upd is not None
     net2.params = params
+    net2.updater_state = upd  # Adam moments survive the restart
     small = DataParallelTrainer(
         net2, mesh=make_mesh((4,), ("data",),
                              devices=jax.devices()[:4]))

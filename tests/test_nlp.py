@@ -287,3 +287,31 @@ class TestStemmer:
 
         pre = StemmingPreProcessor()
         assert pre("Running") == "run"
+
+
+def test_make_pairs_vectorized_matches_bruteforce():
+    """The vectorized windowing must produce exactly the classic pair set:
+    context j for center i iff |j-i| <= window - b[i], within sentence."""
+    import numpy as np
+
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    w2v = Word2Vec(vector_length=8, window=3, subsample=0.0)
+    sents = [np.array([1, 2, 3, 4, 5]), np.array([6, 7]), np.array([8]),
+             np.array([9, 1, 2, 9, 3, 1])]
+    got = w2v._make_pairs(sents, np.random.default_rng(5))
+
+    # oracle replays the same rng stream: win draw happens on the flat
+    # corpus (no subsampling), then a shuffle we neutralize by sorting
+    rng = np.random.default_rng(5)
+    keep = [s for s in sents if len(s)]
+    flat = np.concatenate(keep)
+    sid = np.repeat(np.arange(len(keep)), [len(s) for s in keep])
+    n = len(flat)
+    win = 3 - rng.integers(0, 3, n)
+    want = []
+    for i in range(n):
+        for j in range(n):
+            if i != j and sid[i] == sid[j] and abs(i - j) <= win[i]:
+                want.append((int(flat[j]), int(flat[i])))
+    assert sorted(map(tuple, got.tolist())) == sorted(want)

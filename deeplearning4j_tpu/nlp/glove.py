@@ -39,15 +39,31 @@ class CoOccurrences:
         self.counts: Dict[Tuple[int, int], float] = defaultdict(float)
 
     def fit(self, encoded: Sequence[np.ndarray]) -> "CoOccurrences":
-        w = self.window
-        for sent in encoded:
-            n = len(sent)
-            for i in range(n):
-                for j in range(max(0, i - w), i):
-                    a, b = int(sent[i]), int(sent[j])
-                    inc = 1.0 / (i - j)
-                    self.counts[(a, b)] += inc
-                    self.counts[(b, a)] += inc
+        """Vectorized: one numpy pass per offset d (weight 1/d, both
+        directions) instead of a Python loop per (token, offset); weighted
+        counts aggregate via np.unique on packed (row, col) keys."""
+        sents = [np.asarray(s, np.int64) for s in encoded if len(s)]
+        if not sents:
+            return self
+        flat = np.concatenate(sents)
+        sid = np.repeat(np.arange(len(sents)), [len(s) for s in sents])
+        n = len(flat)
+        vmax = int(flat.max()) + 1
+        longest = max(len(s) for s in sents)
+        # Aggregate per offset (peak memory O(n), not O(window*n)); cap d
+        # at the longest sentence — larger offsets can never match.
+        for d in range(1, min(self.window, longest - 1) + 1):
+            left = np.arange(n - d)
+            ok = sid[left] == sid[left + d]
+            a, b = flat[left + d][ok], flat[left][ok]   # (later, earlier)
+            if not len(a):
+                continue
+            packed = np.concatenate([a * vmax + b, b * vmax + a])
+            uniq, inv = np.unique(packed, return_inverse=True)
+            sums = np.bincount(inv, minlength=len(uniq)) / d
+            for key, total in zip(uniq, sums):
+                self.counts[(int(key // vmax),
+                             int(key % vmax))] += float(total)
         return self
 
     def to_coo(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

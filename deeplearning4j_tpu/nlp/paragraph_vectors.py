@@ -63,12 +63,12 @@ class ParagraphVectors(Word2Vec):
 
         # DBOW pairs: (input=label, target=word) for every word of the doc;
         # optionally also plain skip-gram pairs to train word vectors.
-        pairs = []
-        for li, sent in zip(label_idx, encoded):
-            for w in sent:
-                pairs.append((li, w))
-        arr = np.asarray(pairs, np.int32) if pairs else np.zeros((0, 2),
-                                                                np.int32)
+        lens = [len(s) for s in encoded]
+        if sum(lens):
+            arr = np.stack([np.repeat(label_idx, lens),
+                            np.concatenate(encoded)], axis=1).astype(np.int32)
+        else:
+            arr = np.zeros((0, 2), np.int32)
         if self.train_words:
             arr = np.concatenate([arr, self._make_pairs(encoded, rng)])
 

@@ -315,3 +315,23 @@ def test_make_pairs_vectorized_matches_bruteforce():
             if i != j and sid[i] == sid[j] and abs(i - j) <= win[i]:
                 want.append((int(flat[j]), int(flat[i])))
     assert sorted(map(tuple, got.tolist())) == sorted(want)
+
+
+def test_cooccurrences_vectorized_matches_bruteforce():
+    import numpy as np
+
+    from deeplearning4j_tpu.nlp.glove import CoOccurrences
+
+    sents = [np.array([1, 2, 3, 1, 4]), np.array([2, 2]), np.array([5])]
+    got = CoOccurrences(window=3).fit(sents).counts
+    want = {}
+    for sent in sents:
+        for i in range(len(sent)):
+            for j in range(max(0, i - 3), i):
+                a, b = int(sent[i]), int(sent[j])
+                inc = 1.0 / (i - j)
+                want[(a, b)] = want.get((a, b), 0.0) + inc
+                want[(b, a)] = want.get((b, a), 0.0) + inc
+    assert set(got) == set(want)
+    for k in want:
+        assert abs(got[k] - want[k]) < 1e-9, k

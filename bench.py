@@ -357,6 +357,12 @@ def run_suite() -> int:
             r = {"metric": name, "value": None, "unit": None,
                  "error": f"{type(e).__name__}: {e}"}
         r["elapsed_s"] = round(time.perf_counter() - t0, 1)
+        try:
+            import jax
+
+            r.setdefault("backend", jax.default_backend())
+        except Exception:  # noqa: BLE001 - annotation only
+            pass
         results.append(r)
         _apply_baselines(results, canonical)
         print(json.dumps(r), file=sys.stderr, flush=True)

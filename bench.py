@@ -347,6 +347,12 @@ def run_suite() -> int:
     # Only canonical runs may overwrite the results-of-record file; smoke
     # runs (BENCH_ONLY / small steps) write a sidecar instead.
     out_name = "BENCH_full.json" if canonical else "BENCH_smoke.json"
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 - annotation only
+        backend = None
     results, record = [], None
     for name in names:
         print(f"bench {name}: start", file=sys.stderr, flush=True)
@@ -357,12 +363,8 @@ def run_suite() -> int:
             r = {"metric": name, "value": None, "unit": None,
                  "error": f"{type(e).__name__}: {e}"}
         r["elapsed_s"] = round(time.perf_counter() - t0, 1)
-        try:
-            import jax
-
-            r.setdefault("backend", jax.default_backend())
-        except Exception:  # noqa: BLE001 - annotation only
-            pass
+        if backend is not None:
+            r.setdefault("backend", backend)
         results.append(r)
         _apply_baselines(results, canonical)
         print(json.dumps(r), file=sys.stderr, flush=True)

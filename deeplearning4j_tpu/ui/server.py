@@ -15,6 +15,9 @@ Endpoint parity with `UiServer.run():75-87`:
 - GET  /weights               latest + history summary     (WeightResource)
 - GET  /activations           activation grid as nested lists
 - POST /activations           upload an activation grid    (ActivationsResource)
+- POST /lm/generate           KV-cached LM generation for the model
+                              registered via UiServer.serve_lm(cfg, params)
+                              (beyond the reference: LM serving)
 
 All payloads are JSON. `port=0` picks a free port (tests).
 """
@@ -41,6 +44,7 @@ class _UiState:
         self.nn_tree = None
         self.weights_history: List[dict] = []
         self.activations: Optional[List] = None
+        self.lm = None  # (TransformerConfig, params) via serve_lm
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -163,6 +167,31 @@ class _Handler(BaseHTTPRequestHandler):
             with s.lock:
                 s.activations = body["activations"]
             self._json(200, {"ok": True})
+        elif self.path == "/lm/generate":
+            # Serve the registered TransformerLM (UiServer.serve_lm) via the
+            # KV-cached decoder — LM serving the 2015 reference never had.
+            with s.lock:
+                lm = s.lm
+            if lm is None:
+                self._json(400, {"error": "no LM registered: call "
+                                          "UiServer.serve_lm(cfg, params)"})
+                return
+            import jax
+
+            from deeplearning4j_tpu.parallel import generate
+
+            cfg, params = lm
+            prompt = body.get("prompt_ids")
+            if not prompt:
+                self._json(400, {"error": "prompt_ids required"})
+                return
+            temperature = float(body.get("temperature", 0.0))
+            out = generate(
+                cfg, params, np.asarray([prompt], np.int32),
+                max_new_tokens=int(body.get("max_new_tokens", 32)),
+                temperature=temperature,
+                rng=jax.random.PRNGKey(int(body.get("seed", 0))))
+            self._json(200, {"ids": np.asarray(out)[0].tolist()})
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -184,6 +213,12 @@ class UiServer:
     @property
     def state(self) -> _UiState:
         return self._server.ui_state  # type: ignore[attr-defined]
+
+    def serve_lm(self, cfg, params) -> "UiServer":
+        """Register a TransformerLM for POST /lm/generate."""
+        with self.state.lock:
+            self.state.lm = (cfg, params)
+        return self
 
     def start(self) -> "UiServer":
         self._thread.start()

@@ -133,3 +133,23 @@ def test_unknown_path_404(server):
     with pytest.raises(urllib.error.HTTPError) as exc:
         _get(server.url + "/nope")
     assert exc.value.code == 404
+
+
+def test_lm_generate_endpoint():
+    import jax
+
+    from deeplearning4j_tpu.parallel import transformer as tfm
+    from deeplearning4j_tpu.ui.server import UiServer
+
+    cfg = tfm.TransformerConfig(vocab_size=50, d_model=16, n_heads=2,
+                                n_layers=1, d_ff=32, max_len=16)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    srv = UiServer(port=0).serve_lm(cfg, params).start()
+    try:
+        out = _post(srv.url + "/lm/generate",
+                    {"prompt_ids": [1, 2, 3], "max_new_tokens": 4})
+        assert len(out["ids"]) == 7
+        assert out["ids"][:3] == [1, 2, 3]
+        assert all(0 <= t < 50 for t in out["ids"])
+    finally:
+        srv.stop()

@@ -1,0 +1,82 @@
+"""Closed-form convolution + pooling expectations.
+
+Gold-standard style on the conv stack: a VALID-padding NHWC conv and
+max/avg pooling are hand-computed with explicit numpy loops and asserted
+against the XLA layer implementations (reference ConvolutionLayer.java:49,
+SubsamplingLayer.java:51).
+"""
+
+import numpy as np
+
+import jax
+
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayerConf,
+    SubsamplingLayerConf,
+)
+from deeplearning4j_tpu.nn.layers.convolution import (
+    conv_apply,
+    conv_init,
+    pool_apply,
+)
+
+
+def _manual_conv_valid(x, W, b, stride):
+    """NHWC x, HWIO W — direct nested-loop cross-correlation."""
+    n, h, w, cin = x.shape
+    kh, kw, _, cout = W.shape
+    sh, sw = stride
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    out = np.zeros((n, oh, ow, cout))
+    for b_ in range(n):
+        for i in range(oh):
+            for j in range(ow):
+                patch = x[b_, i * sh:i * sh + kh, j * sw:j * sw + kw, :]
+                for c in range(cout):
+                    out[b_, i, j, c] = np.sum(patch * W[..., c]) + b[c]
+    return out
+
+
+def test_conv_valid_matches_manual_cross_correlation():
+    conf = ConvolutionLayerConf(n_in=2, n_out=3, kernel_size=(3, 2),
+                                stride=(2, 1), padding="VALID",
+                                activation="linear")
+    params, state = conv_init(conf, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2, 7, 5, 2)).astype(np.float32)
+    got, _ = conv_apply(conf, params, state, x)
+    want = _manual_conv_valid(x.astype(np.float64),
+                              np.asarray(params["W"], np.float64),
+                              np.asarray(params["b"], np.float64),
+                              (2, 1))
+    assert got.shape == want.shape == (2, 3, 4, 3)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_relu_applied_after_bias():
+    conf = ConvolutionLayerConf(n_in=1, n_out=1, kernel_size=(1, 1),
+                                activation="relu")
+    params, state = conv_init(conf, jax.random.PRNGKey(1))
+    import jax.numpy as jnp
+
+    params = {"W": jnp.ones((1, 1, 1, 1), jnp.float32),
+              "b": jnp.asarray([-2.0], jnp.float32)}
+    x = np.array([[[[1.0], [3.0]]]], np.float32)  # [1,1,2,1]
+    got, _ = conv_apply(conf, params, state, x)
+    np.testing.assert_allclose(np.asarray(got)[0, 0, :, 0], [0.0, 1.0])
+
+
+def test_max_and_avg_pooling_closed_form():
+    x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+    for kind, want in (
+        ("max", [[5, 7], [13, 15]]),
+        ("avg", [[2.5, 4.5], [10.5, 12.5]]),
+    ):
+        conf = SubsamplingLayerConf(pooling_type=kind)
+        got, _ = pool_apply(conf, {}, {}, x)
+        np.testing.assert_allclose(np.asarray(got)[0, :, :, 0], want)
+    conf = SubsamplingLayerConf(pooling_type="sum")
+    got, _ = pool_apply(conf, {}, {}, x)
+    np.testing.assert_allclose(np.asarray(got)[0, :, :, 0],
+                               [[10, 18], [42, 50]])

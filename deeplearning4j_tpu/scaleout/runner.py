@@ -116,7 +116,14 @@ class Worker:
             self.performed += 1
 
     def stop(self) -> None:
+        """Graceful shutdown: deregister so a reused tracker doesn't carry
+        dead workers into the next run (contrast kill(), which leaves the
+        registration for the reaper to find)."""
         self._stop.set()
+        try:
+            self.tracker.remove_worker(self.worker_id)
+        except Exception:  # noqa: BLE001 - tracker may already be gone
+            pass
 
     def kill(self) -> None:
         """Simulate failure: stop heartbeating AND working without
@@ -232,6 +239,9 @@ class DistributedRunner:
                  timeout: float = 300.0,
                  save_fn: Optional[Callable[[Any, int], None]] = None,
                  save_every: int = 0) -> Any:
+        # Re-arm after a previous simulate(): the finished flag would make
+        # freshly-started workers exit before the first job lands.
+        self.tracker.reset_done()
         if initial_model is not None:
             self.tracker.set_global(MODEL_KEY, initial_model)
         workers = [

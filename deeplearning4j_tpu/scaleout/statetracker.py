@@ -160,6 +160,11 @@ class StateTracker:
     def finish(self) -> None:
         self._done.set()
 
+    def reset_done(self) -> None:
+        """Re-arm the tracker for another run (reference: a fresh
+        IterativeReduce round resets the coordination state)."""
+        self._done.clear()
+
     def is_done(self) -> bool:
         return self._done.is_set()
 

@@ -1,0 +1,58 @@
+"""Distributed control-plane demo: in-process cluster with param averaging.
+
+Runs the scaleout stack the way the reference's Akka/Hazelcast runtime did
+(master + workers + StateTracker with heartbeats and reaping), entirely
+in-process — the IRUnitDriver-style simulation the test suite uses, made
+runnable:
+
+  python examples/distributed_cluster.py
+
+Each worker trains a MultiLayerNetwork replica on its shard of Iris;
+the master averages parameters every round (IterativeReduce) and the
+final model is evaluated on the full set. For real SPMD scale-out over a
+TPU mesh use DataParallelTrainer (examples/data_parallel_scaling.py) —
+this control plane is the host-level job/heartbeat/elasticity layer.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.fetchers import iris_dataset
+from deeplearning4j_tpu.models import MultiLayerNetwork, iris_mlp
+from deeplearning4j_tpu.scaleout import (
+    DistributedRunner,
+    NetworkPerformer,
+    ParameterAveragingAggregator,
+)
+
+
+def main():
+    ds = iris_dataset()
+    conf = iris_mlp()
+    conf_json = conf.to_json()
+    master = MultiLayerNetwork(conf).init()
+
+    # 4 shards of Iris = 4 jobs per round; 2 worker threads
+    idx = np.array_split(np.random.default_rng(0).permutation(150), 4)
+    shards = [(ds.features[i], ds.labels[i]) for i in idx]
+
+    runner = DistributedRunner()
+    for round_no in range(10):
+        final = runner.simulate(
+            payloads=shards,
+            performer_factory=lambda: NetworkPerformer(conf_json, epochs=2),
+            aggregator=ParameterAveragingAggregator(),
+            n_workers=2,
+            initial_model=master.params,
+        )
+        master.params = final
+        acc = master.evaluate(ds.features, ds.labels).accuracy()
+        print(f"round {round_no}: accuracy {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -342,3 +342,53 @@ class TestWordCount:
         assert result.get_count("the") == 3
         assert result.get_count("cat") == 2
         assert result.get_count("mat") == 1
+
+
+class TestRepeatedSimulate:
+    def test_second_simulate_on_same_runner_works(self):
+        """A finished tracker must re-arm: round 2 on the same runner used
+        to dead-lock with 'no live workers' (done flag persisted)."""
+        from deeplearning4j_tpu.scaleout import (
+            DistributedRunner,
+            ParameterAveragingAggregator,
+        )
+        from deeplearning4j_tpu.scaleout.api import WorkerPerformer
+
+        class AddOne(WorkerPerformer):
+            def __init__(self):
+                self.model = 0.0
+
+            def perform(self, job):
+                job.result = job.work + 1.0
+
+            def update(self, model):
+                self.model = model
+
+        runner = DistributedRunner()
+        agg = ParameterAveragingAggregator()
+        r1 = runner.simulate([1.0, 3.0], AddOne, agg, n_workers=2)
+        r2 = runner.simulate([5.0, 7.0], AddOne, agg, n_workers=2)
+        assert r1 == 3.0   # mean(2, 4)
+        assert r2 == 7.0   # mean(6, 8)
+
+    def test_stop_deregisters_worker_but_kill_does_not(self):
+        from deeplearning4j_tpu.scaleout.runner import Worker
+        from deeplearning4j_tpu.scaleout.statetracker import StateTracker
+
+        class Noop:
+            def perform(self, job):
+                pass
+
+            def update(self, model):
+                pass
+
+        tracker = StateTracker()
+        w1 = Worker(tracker, Noop(), heartbeat_interval=0.05).start()
+        w2 = Worker(tracker, Noop(), heartbeat_interval=0.05).start()
+        assert len(tracker.workers()) == 2
+        w1.stop()
+        w1.join()
+        assert w1.worker_id not in tracker.workers()
+        w2.kill()   # failure path keeps registration for the reaper
+        w2.join()
+        assert w2.worker_id in tracker.workers()

@@ -73,22 +73,43 @@ def _time_steps(step_fn, warmup: int, steps: int) -> float:
 # the five BASELINE.md configs
 # ---------------------------------------------------------------------------
 
+def _lenet_train_flops_per_example() -> float:
+    """Matmul/conv FLOPs for one LeNet training example (fwd 2*MACs;
+    train ~3x fwd for the backward's two GEMMs per layer)."""
+    fwd = (
+        2 * (28 * 28 * 6 * 5 * 5 * 1)        # conv1 SAME 28x28x6
+        + 2 * (10 * 10 * 16 * 5 * 5 * 6)     # conv2 VALID 10x10x16
+        + 2 * (400 * 120) + 2 * (120 * 84) + 2 * (84 * 10)
+    )
+    return 3.0 * fwd
+
+
+def _peak_flops(on_tpu: bool) -> float:
+    return float(os.environ.get("BENCH_PEAK_FLOPS",
+                                197e12 if on_tpu else 1e12))
+
+
 def bench_lenet() -> dict:
     """#1: LeNet-5 MNIST-shape training throughput (metric of record).
-    bf16 compute on TPU (MXU native rate; master weights stay f32)."""
+    bf16 compute on TPU (MXU native rate; master weights stay f32);
+    reports step time + derived MFU alongside examples/sec."""
     import jax
 
     from deeplearning4j_tpu.models import MultiLayerNetwork, lenet_mnist
 
-    dtype = "bfloat16" if jax.default_backend() == "tpu" else "float32"
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = "bfloat16" if on_tpu else "float32"
     net = MultiLayerNetwork(
         lenet_mnist(updater="sgd", compute_dtype=dtype)).init()
     rng = np.random.default_rng(0)
     x = np.asarray(rng.random((BATCH, 28, 28, 1), dtype=np.float32))
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)]
     sec = _time_steps(lambda: net.fit_batch_async(x, y), WARMUP, STEPS)
+    flops = BATCH * _lenet_train_flops_per_example()
     return {"metric": RECORD_METRIC, "value": round(BATCH / sec, 1),
-            "unit": "examples/sec", "dtype": dtype}
+            "unit": "examples/sec", "dtype": dtype,
+            "step_ms": round(sec * 1e3, 3),
+            "mfu": round(flops / sec / _peak_flops(on_tpu), 5)}
 
 
 def bench_iris() -> dict:
@@ -110,6 +131,8 @@ def bench_iris() -> dict:
 def bench_lstm() -> dict:
     """#4: character-level LSTM LM (GravesLSTM.java:47 parity config) —
     examples/sec/chip at batch 32, seq 64, vocab 80, hidden 256."""
+    import jax
+
     from deeplearning4j_tpu.models import MultiLayerNetwork, char_lstm
 
     V, B, T, H = 80, 32, 64, 256
@@ -120,9 +143,13 @@ def bench_lstm() -> dict:
     y = np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)]
     steps = max(20, STEPS // 2)
     sec = _time_steps(lambda: net.fit_batch_async(x, y), WARMUP, steps)
+    # per-timestep MACs: input proj V*4H + recurrent H*4H + head H*V
+    flops = 3.0 * 2 * B * T * (V * 4 * H + H * 4 * H + H * V)
+    on_tpu = jax.default_backend() == "tpu"
     return {"metric": "charLSTM train examples/sec/chip",
             "unit": "examples/sec", "value": round(B / sec, 1),
-            "batch": B, "seq_len": T}
+            "batch": B, "seq_len": T, "step_ms": round(sec * 1e3, 3),
+            "mfu": round(flops / sec / _peak_flops(on_tpu), 5)}
 
 
 def bench_word2vec() -> dict:
@@ -239,8 +266,7 @@ def bench_transformer() -> dict:
     # 12 * L * B * S^2 * d (score + value matmuls, fwd and bwd).
     flops = (6 * B * S * n_params
              + 12 * cfg.n_layers * B * S * S * cfg.d_model)
-    peak = float(os.environ.get(
-        "BENCH_PEAK_FLOPS", 197e12 if on_tpu else 1e12))
+    peak = _peak_flops(on_tpu)
     return {"metric": "TransformerLM train tokens/sec/chip",
             "unit": "tokens/sec", "value": round(B * S / sec, 1),
             "mfu": round(flops / sec / peak, 4), "params": n_params,

@@ -115,11 +115,16 @@ class Worker:
             self.tracker.clear_job(self.worker_id)
             self.performed += 1
 
-    def stop(self) -> None:
-        """Graceful shutdown: deregister so a reused tracker doesn't carry
-        dead workers into the next run (contrast kill(), which leaves the
-        registration for the reaper to find)."""
+    def stop(self, timeout: float = 5.0) -> None:
+        """Graceful shutdown: drain the work thread FIRST (an in-flight
+        perform must finish and clear its job — deregistering mid-perform
+        would re-queue the job while its update still posts, double-
+        counting it), then deregister so a reused tracker doesn't carry
+        dead workers into the next run. Contrast kill(), which leaves the
+        registration for the reaper to find."""
         self._stop.set()
+        for t in self._threads:
+            t.join(timeout)
         try:
             self.tracker.remove_worker(self.worker_id)
         except Exception:  # noqa: BLE001 - tracker may already be gone
@@ -240,8 +245,9 @@ class DistributedRunner:
                  save_fn: Optional[Callable[[Any, int], None]] = None,
                  save_every: int = 0) -> Any:
         # Re-arm after a previous simulate(): the finished flag would make
-        # freshly-started workers exit before the first job lands.
-        self.tracker.reset_done()
+        # freshly-started workers exit before the first job lands, and a
+        # failed run's stale jobs/updates must not leak into this one.
+        self.tracker.reset_run_state()
         if initial_model is not None:
             self.tracker.set_global(MODEL_KEY, initial_model)
         workers = [

@@ -161,9 +161,20 @@ class StateTracker:
         self._done.set()
 
     def reset_done(self) -> None:
-        """Re-arm the tracker for another run (reference: a fresh
-        IterativeReduce round resets the coordination state)."""
+        """Re-arm the done flag for another run."""
         self._done.clear()
+
+    def reset_run_state(self) -> None:
+        """Full re-arm between runs (reference: a fresh IterativeReduce
+        launch starts with clean coordination state): clears the done
+        flag AND any stale queued/in-flight jobs and undrained updates a
+        previous (possibly failed) run left behind — without touching
+        worker registrations, globals, or persisted work."""
+        self._done.clear()
+        with self._lock:
+            self._job_queue.clear()
+            self._current_jobs.clear()
+            self._updates.clear()
 
     def is_done(self) -> bool:
         return self._done.is_set()

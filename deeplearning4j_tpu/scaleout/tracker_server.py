@@ -40,7 +40,7 @@ _ALLOWED = {
     "pending_jobs", "clear_job", "add_update", "updates", "drain_updates",
     "clear_updates",
     "set_global", "get_global", "increment", "counter", "finish", "is_done",
-    "reset_done",
+    "reset_done", "reset_run_state",
     "saved_work", "load_saved_work",
 }
 

@@ -392,3 +392,21 @@ class TestRepeatedSimulate:
         w2.kill()   # failure path keeps registration for the reaper
         w2.join()
         assert w2.worker_id in tracker.workers()
+
+    def test_reset_run_state_clears_stale_jobs_and_updates(self):
+        from deeplearning4j_tpu.scaleout.api import Job
+        from deeplearning4j_tpu.scaleout.statetracker import StateTracker
+
+        tracker = StateTracker()
+        tracker.add_worker("w1")
+        tracker.enqueue_job(Job(work=1.0))
+        tracker.enqueue_job(Job(work=2.0))
+        assert tracker.request_job("w1") is not None  # now in-flight
+        tracker.add_update("w1", 99.0)
+        tracker.finish()
+        tracker.reset_run_state()
+        assert not tracker.is_done()
+        assert tracker.pending_jobs() == 0
+        assert tracker.current_jobs() == []
+        assert tracker.drain_updates() == []
+        assert "w1" in tracker.workers()  # registrations survive

@@ -42,8 +42,21 @@ def flash_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _pick_block(s: int, target: int = 128) -> int:
-    """Largest divisor of s that is <= target (block sizes must tile S)."""
+def _pick_block(s: int, target: int = None, kind: str = "q") -> int:
+    """Largest divisor of s that is <= target (block sizes must tile S).
+    Tunable per-axis via DL4J_TPU_FLASH_BQ / DL4J_TPU_FLASH_BK (the VMEM
+    residency/occupancy trade-off differs per chip generation)."""
+    import os
+
+    if target is None:
+        env = os.environ.get(f"DL4J_TPU_FLASH_B{kind.upper()}")
+        target = 128
+        if env:
+            if int(env) <= 0:
+                raise ValueError(
+                    f"DL4J_TPU_FLASH_B{kind.upper()}={env}: block size "
+                    f"target must be a positive integer")
+            target = int(env)
     b = min(s, target)
     while s % b:
         b -= 1
@@ -110,8 +123,8 @@ def _unfold(x, b, s, h, d):
 def _flash_forward(q, k, v, causal: bool, interpret: bool):
     """Returns (out [B,S,H,D], lse [B*H, S])."""
     b, s, h, d = q.shape
-    bq = _pick_block(s)
-    bk = _pick_block(s)
+    bq = _pick_block(s, kind="q")
+    bk = _pick_block(s, kind="k")
     n_kv_blocks = s // bk
     scale = 1.0 / (d ** 0.5)
 
@@ -242,8 +255,8 @@ def _bwd_block(q, k, v, g, lse, delta, causal: bool, interpret: bool):
     ring attention's distributed backward.
     """
     b, s, h, d = q.shape
-    bq = _pick_block(s)
-    bk = _pick_block(s)
+    bq = _pick_block(s, kind="q")
+    bk = _pick_block(s, kind="k")
     scale = 1.0 / (d ** 0.5)
 
     qf, kf, vf, gf = (_fold(x, b, s, h, d) for x in (q, k, v, g))

@@ -115,16 +115,24 @@ class Worker:
             self.tracker.clear_job(self.worker_id)
             self.performed += 1
 
+    def request_stop(self) -> None:
+        """Signal the loops without blocking (stop() = request + drain)."""
+        self._stop.set()
+
     def stop(self, timeout: float = 5.0) -> None:
         """Graceful shutdown: drain the work thread FIRST (an in-flight
         perform must finish and clear its job — deregistering mid-perform
         would re-queue the job while its update still posts, double-
         counting it), then deregister so a reused tracker doesn't carry
-        dead workers into the next run. Contrast kill(), which leaves the
-        registration for the reaper to find."""
+        dead workers into the next run. If the drain times out the
+        registration is LEFT for the reaper (deregistering a live worker
+        would reintroduce the double-count). Contrast kill(), which never
+        deregisters."""
         self._stop.set()
         for t in self._threads:
             t.join(timeout)
+        if any(t.is_alive() for t in self._threads):
+            return  # still mid-perform: the reaper owns cleanup
         try:
             self.tracker.remove_worker(self.worker_id)
         except Exception:  # noqa: BLE001 - tracker may already be gone
@@ -263,6 +271,6 @@ class DistributedRunner:
             return master.run(timeout=timeout)
         finally:
             for w in workers:
-                w.stop()
+                w.request_stop()   # signal everyone before draining anyone
             for w in workers:
-                w.join()
+                w.stop()

@@ -172,6 +172,12 @@ class StateTracker:
         worker registrations, globals, or persisted work."""
         self._done.clear()
         with self._lock:
+            if self.work_dir:
+                # the cleared jobs can never reach clear_job, so their
+                # persisted files must go now or saved_work() leaks them
+                for job in list(self._job_queue) + list(
+                        self._current_jobs.values()):
+                    self._unpersist_job(job)
             self._job_queue.clear()
             self._current_jobs.clear()
             self._updates.clear()

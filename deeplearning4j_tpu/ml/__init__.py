@@ -16,10 +16,20 @@ from deeplearning4j_tpu.ml.pipeline import (
     Pipeline,
     StandardScaler,
 )
+from deeplearning4j_tpu.ml.sources import (
+    SOURCES,
+    DataSource,
+    load_source,
+    source_schema,
+)
 
 __all__ = [
     "NetworkClassifier",
     "NetworkReconstruction",
     "Pipeline",
     "StandardScaler",
+    "DataSource",
+    "SOURCES",
+    "load_source",
+    "source_schema",
 ]

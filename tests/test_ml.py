@@ -130,3 +130,34 @@ class TestTpuPodProvisioner:
         assert "--worker=all" in run
         delete = prov.delete_command()
         assert "pod0" in delete and "--quiet" in delete
+
+
+def test_data_sources_registry(monkeypatch, tmp_path):
+    monkeypatch.setenv("DL4J_NO_DOWNLOAD", "1")
+    monkeypatch.setenv("DL4J_CACHE_DIR", str(tmp_path))
+    from deeplearning4j_tpu.ml import load_source, source_schema, SOURCES
+
+    assert set(SOURCES) >= {"iris", "mnist", "lfw", "cifar10", "newsgroups"}
+    ds = load_source("iris")
+    assert ds.features.shape == (150, 4)
+    assert source_schema("iris")["num_classes"] == 3
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError):
+        load_source("imagenet")
+
+
+def test_source_feeds_estimator(monkeypatch, tmp_path):
+    monkeypatch.setenv("DL4J_NO_DOWNLOAD", "1")
+    monkeypatch.setenv("DL4J_CACHE_DIR", str(tmp_path))
+    import numpy as np
+
+    from deeplearning4j_tpu.ml import NetworkClassifier, load_source
+    from deeplearning4j_tpu.models import iris_mlp
+
+    ds = load_source("iris")
+    clf = NetworkClassifier(iris_mlp(), epochs=60)
+    clf.fit(np.asarray(ds.features), np.asarray(ds.labels).argmax(1))
+    acc = (clf.predict(np.asarray(ds.features))
+           == np.asarray(ds.labels).argmax(1)).mean()
+    assert acc > 0.9

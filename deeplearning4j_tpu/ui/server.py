@@ -32,6 +32,82 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 
+# Human-viewable dashboard (the reference served FreeMarker pages from the
+# Dropwizard app — UiServer.java view bundles). One self-contained page:
+# polls the JSON endpoints and renders score curve, weight histograms and
+# t-SNE scatter with inline SVG. No external assets (zero-egress friendly).
+_DASHBOARD = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>deeplearning4j_tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.2rem} h2{font-size:1rem;margin:1.2rem 0 .3rem}
+ .card{background:#fff;border:1px solid #ddd;border-radius:6px;
+       padding:.8rem;margin-bottom:1rem;max-width:720px}
+ svg{width:100%;height:220px;background:#fcfcfc;border:1px solid #eee}
+ .muted{color:#777;font-size:.85rem}
+</style></head><body>
+<h1>deeplearning4j_tpu — training dashboard</h1>
+<div class="card"><h2>Training score (from /weights posts)</h2>
+ <svg id="score" viewBox="0 0 600 220" preserveAspectRatio="none"></svg>
+ <div class="muted" id="scoreinfo">waiting for HistogramIterationListener
+ posts…</div></div>
+<div class="card"><h2>Latest weight histogram</h2>
+ <svg id="hist" viewBox="0 0 600 220" preserveAspectRatio="none"></svg>
+ <div class="muted" id="histinfo"></div></div>
+<div class="card"><h2>t-SNE coords (from /tsne/generate)</h2>
+ <svg id="tsne" viewBox="0 0 600 220"></svg></div>
+<script>
+function poly(el, pts, color){
+  el.innerHTML = pts.length >= 2
+    ? '<polyline fill="none" stroke="'+color+'" stroke-width="2" points="'
+      + pts.map(p=>p.join(',')).join(' ') + '"/>' : '';
+}
+function scale(vals, lo, hi){
+  const mn=Math.min(...vals), mx=Math.max(...vals), r=(mx-mn)||1;
+  return vals.map(v=> lo + (v-mn)/r*(hi-lo));
+}
+async function tick(){
+  try{
+    const w = await (await fetch('/weights')).json();
+    if(w.count){
+      document.getElementById('scoreinfo').textContent =
+        w.count+' posts; last iteration '+(w.last.iteration??'?')
+        +', score '+(w.last.score??'?');
+      if(!window._scores) window._scores=[];
+      if(w.last.score!==undefined &&
+         (!window._lastIter || w.last.iteration!==window._lastIter)){
+        window._scores.push(w.last.score); window._lastIter=w.last.iteration;
+      }
+      const ys=scale(window._scores.map(v=>-v),10,210);
+      const xs=scale(window._scores.map((_,i)=>i),10,590);
+      poly(document.getElementById('score'), xs.map((x,i)=>[x,ys[i]]),
+           '#1669c1');
+      const h = w.last.histograms && Object.entries(w.last.histograms)[0];
+      if(h){
+        document.getElementById('histinfo').textContent=h[0];
+        const bins=h[1].counts||h[1];
+        const bw=580/bins.length, mx=Math.max(...bins)||1;
+        document.getElementById('hist').innerHTML = bins.map((c,i)=>
+          '<rect x="'+(10+i*bw)+'" y="'+(210-200*c/mx)+'" width="'
+          +(bw-1)+'" height="'+(200*c/mx)+'" fill="#52a447"/>').join('');
+      }
+    }
+    const t = await (await fetch('/tsne/coords')).json();
+    if(t.coords && t.coords.length){
+      const xs=scale(t.coords.map(c=>c[0]),10,590);
+      const ys=scale(t.coords.map(c=>c[1]),10,210);
+      document.getElementById('tsne').innerHTML = xs.map((x,i)=>
+        '<circle cx="'+x+'" cy="'+ys[i]+'" r="3" fill="#c14a16"/>'
+      ).join('');
+    }
+  }catch(e){/* server may not have data yet */}
+  setTimeout(tick, 2000);
+}
+tick();
+</script></body></html>
+"""
+
+
 class _UiState:
     def __init__(self):
         self.lock = threading.Lock()
@@ -70,9 +146,20 @@ class _Handler(BaseHTTPRequestHandler):
             return {}
         return json.loads(self.rfile.read(length))
 
+    def _html(self, body: str) -> None:
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     # ---- GET --------------------------------------------------------------
     def do_GET(self) -> None:  # noqa: N802
         s = self.state
+        if self.path in ("/", "/index.html"):
+            self._html(_DASHBOARD)
+            return
         with s.lock:
             if self.path == "/api/coords":
                 self._json(200, {"coords": s.coords})

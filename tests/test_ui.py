@@ -153,3 +153,19 @@ def test_lm_generate_endpoint():
         assert all(0 <= t < 50 for t in out["ids"])
     finally:
         srv.stop()
+
+
+def test_dashboard_page_served():
+    import urllib.request
+
+    from deeplearning4j_tpu.ui.server import UiServer
+
+    srv = UiServer(port=0).start()
+    try:
+        with urllib.request.urlopen(srv.url + "/", timeout=10) as r:
+            body = r.read().decode()
+            assert r.headers["Content-Type"].startswith("text/html")
+        assert "training dashboard" in body
+        assert "/tsne/coords" in body  # polls the JSON endpoints
+    finally:
+        srv.stop()

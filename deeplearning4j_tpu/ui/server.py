@@ -73,24 +73,25 @@ async function tick(){
       document.getElementById('scoreinfo').textContent =
         w.count+' posts; last iteration '+(w.last.iteration??'?')
         +', score '+(w.last.score??'?');
-      if(!window._scores) window._scores=[];
-      if(w.last.score!==undefined &&
-         (!window._lastIter || w.last.iteration!==window._lastIter)){
-        window._scores.push(w.last.score); window._lastIter=w.last.iteration;
+      const scores=(w.history||[]).map(h=>h.score);
+      if(scores.length){
+        const ys=scale(scores.map(v=>-v),10,210);
+        const xs=scale(scores.map((_,i)=>i),10,590);
+        poly(document.getElementById('score'), xs.map((x,i)=>[x,ys[i]]),
+             '#1669c1');
       }
-      const ys=scale(window._scores.map(v=>-v),10,210);
-      const xs=scale(window._scores.map((_,i)=>i),10,590);
-      poly(document.getElementById('score'), xs.map((x,i)=>[x,ys[i]]),
-           '#1669c1');
-      const h = w.last.histograms && Object.entries(w.last.histograms)[0];
-      if(h){
-        document.getElementById('histinfo').textContent=h[0];
-        const bins=h[1].counts||h[1];
-        const bw=580/bins.length, mx=Math.max(...bins)||1;
-        document.getElementById('hist').innerHTML = bins.map((c,i)=>
-          '<rect x="'+(10+i*bw)+'" y="'+(210-200*c/mx)+'" width="'
-          +(bw-1)+'" height="'+(200*c/mx)+'" fill="#52a447"/>').join('');
-      }
+      try{
+        const h = w.last.histograms && Object.entries(w.last.histograms)[0];
+        const bins = h && (Array.isArray(h[1].counts)?h[1].counts
+                          :(Array.isArray(h[1])?h[1]:null));
+        if(bins && bins.length){
+          document.getElementById('histinfo').textContent=h[0];
+          const bw=580/bins.length, mx=Math.max(...bins)||1;
+          document.getElementById('hist').innerHTML = bins.map((c,i)=>
+            '<rect x="'+(10+i*bw)+'" y="'+(210-200*c/mx)+'" width="'
+            +(bw-1)+'" height="'+(200*c/mx)+'" fill="#52a447"/>').join('');
+        }
+      }catch(e){/* malformed histogram post must not block t-SNE */}
     }
     const t = await (await fetch('/tsne/coords')).json();
     if(t.coords && t.coords.length){
@@ -132,13 +133,15 @@ class _Handler(BaseHTTPRequestHandler):
     def state(self) -> _UiState:
         return self.server.ui_state  # type: ignore[attr-defined]
 
-    def _json(self, code: int, payload: Any) -> None:
-        data = json.dumps(payload).encode()
+    def _send(self, code: int, ctype: str, data: bytes) -> None:
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _json(self, code: int, payload: Any) -> None:
+        self._send(code, "application/json", json.dumps(payload).encode())
 
     def _body(self) -> Any:
         length = int(self.headers.get("Content-Length", 0))
@@ -147,12 +150,7 @@ class _Handler(BaseHTTPRequestHandler):
         return json.loads(self.rfile.read(length))
 
     def _html(self, body: str) -> None:
-        data = body.encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "text/html; charset=utf-8")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+        self._send(200, "text/html; charset=utf-8", body.encode())
 
     # ---- GET --------------------------------------------------------------
     def do_GET(self) -> None:  # noqa: N802
@@ -167,8 +165,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, {"coords": s.tsne_coords,
                                  "labels": s.tsne_labels})
             elif self.path == "/weights":
+                hist = [{"iteration": h.get("iteration"),
+                         "score": h.get("score")}
+                        for h in s.weights_history[-200:]
+                        if isinstance(h, dict) and h.get("score") is not None]
                 self._json(200, {
                     "count": len(s.weights_history),
+                    "history": hist,
                     "last": s.weights_history[-1] if s.weights_history
                     else None})
             elif self.path == "/activations":

@@ -293,6 +293,9 @@ class MultiLayerNetwork:
                     for b in data]
         if self.conf.pretrain:
             self.pretrain(data, epochs=1)
+        algo = self.conf.conf.optimization_algo
+        if algo and algo != "stochastic_gradient_descent":
+            return self._fit_with_solver(data, epochs, algo)
         loss = None
         for _ in range(epochs):
             for batch in _as_batches(data):
@@ -301,6 +304,52 @@ class MultiLayerNetwork:
             _maybe_reset(data)
         if loss is not None:
             jax.block_until_ready(loss)
+        return self
+
+    def _fit_with_solver(self, data, epochs: int,
+                         algo: str) -> "MultiLayerNetwork":
+        """Dispatch on conf.optimization_algo (reference
+        Solver.getOptimizer():56-71): LINE_GRADIENT_DESCENT /
+        CONJUGATE_GRADIENT / LBFGS / HESSIAN_FREE run the line-search
+        solver machinery over the flat-parameter objective, honoring
+        num_iterations, max_num_line_search_iterations and minimize."""
+        from deeplearning4j_tpu.optimize.solver import Solver
+
+        if self.params is None:
+            self.init()
+        cfg = self.conf.conf
+
+        def make_solver(x, y, mask):
+            return Solver.for_model(
+                self, x, y, mask=mask, algorithm=algo,
+                num_iterations=max(1, cfg.num_iterations),
+                maximize=not cfg.minimize,
+                max_line_iters=cfg.max_num_line_search_iterations)
+
+        batches = list(_as_batches(data))
+        if len(batches) == 1:
+            # Full-batch training — the solvers' natural regime (reference
+            # LBFGS/CG/HF are full-batch): ONE solver, one compile, reused
+            # across epochs.
+            x, y, mask = batches[0]
+            solver = make_solver(x, y, mask)
+            for _ in range(epochs):
+                solver._x0 = self.params_flat()
+                loss = solver.fit_model()
+                self._iteration += 1
+                for listener in self._listeners:
+                    listener(self._iteration, float(loss))
+            return self
+        # Mini-batched data: each batch is a distinct objective, so a
+        # fresh solver (and compile) per batch is inherent to the
+        # algorithm class — prefer a single full batch with these solvers.
+        for _ in range(epochs):
+            for x, y, mask in batches:
+                loss = make_solver(x, y, mask).fit_model()
+                self._iteration += 1
+                for listener in self._listeners:
+                    listener(self._iteration, float(loss))
+            _maybe_reset(data)
         return self
 
     # ---- greedy layer-wise pretraining ------------------------------------

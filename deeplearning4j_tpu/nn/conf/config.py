@@ -50,6 +50,22 @@ class NeuralNetConfiguration:
     dtype: str = "float32"            # parameter dtype
     compute_dtype: str = "float32"    # activation/matmul dtype (e.g. bfloat16)
 
+    def __post_init__(self):
+        # No config knob may be a silent no-op. step_function variants
+        # beyond the default are subsumed by the solvers' line search; a
+        # value this framework would ignore must fail loudly instead.
+        if self.step_function not in ("default", "negative_gradient"):
+            raise ValueError(
+                f"step_function={self.step_function!r} is not supported: "
+                f"'default' (direction from the chosen solver's line "
+                f"search) and 'negative_gradient' behave identically here; "
+                f"other reference StepFunctions have no analog")
+        algos = ("stochastic_gradient_descent", "line_gradient_descent",
+                 "conjugate_gradient", "lbfgs", "hessian_free")
+        if self.optimization_algo not in algos:
+            raise ValueError(f"optimization_algo="
+                             f"{self.optimization_algo!r}; known: {algos}")
+
     def updater_config(self) -> UpdaterConfig:
         return UpdaterConfig(
             updater=Updater(self.updater),

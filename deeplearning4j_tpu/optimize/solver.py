@@ -52,7 +52,12 @@ class Solver:
                  listeners: Sequence[IterationListener] = (),
                  terminations: Sequence[TerminationCondition] = (),
                  model=None,
+                 maximize: bool = False,
                  **algo_kwargs):
+        self._sign = -1.0 if maximize else 1.0
+        if maximize:  # reference `minimize` flag: maximize f == minimize -f
+            orig = f
+            f = lambda v: -orig(v)  # noqa: E731
         self.f = f
         self.algorithm = OptimizationAlgorithm(algorithm)
         self.num_iterations = num_iterations
@@ -72,7 +77,8 @@ class Solver:
             state = self._step(state)
             f_new = float(state.fval)
             for listener in self.listeners:
-                listener.iteration_done(self.model, i, f_new)
+                # report the USER's objective: un-negate under maximize
+                listener.iteration_done(self.model, i, self._sign * f_new)
             grad = np.asarray(state.grad)
             # Search direction for ZeroDirectionTermination: algorithm aux
             # where it carries one (CG), else steepest descent.
@@ -113,4 +119,4 @@ class Solver:
         result back into the model. Returns the final score."""
         best = self.optimize(self._x0)
         self.model.params = self._unravel(jnp.asarray(best))
-        return float(self.final_state.fval)
+        return float(self._sign * self.final_state.fval)

@@ -21,6 +21,33 @@ hand-coded backprop per layer, this framework is built TPU-first:
 - runtime/    control plane: job queue, heartbeats, checkpointing
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from deeplearning4j_tpu.ops import activations, losses, initializers, updaters  # noqa: F401
+
+# Lazy top-level conveniences (PEP 562): `from deeplearning4j_tpu import
+# MultiLayerNetwork` without paying for every subpackage at import time.
+_LAZY = {
+    "MultiLayerNetwork": ("deeplearning4j_tpu.models", "MultiLayerNetwork"),
+    "get_model": ("deeplearning4j_tpu.models", "get_model"),
+    "DataParallelTrainer": ("deeplearning4j_tpu.parallel",
+                            "DataParallelTrainer"),
+    "make_mesh": ("deeplearning4j_tpu.parallel", "make_mesh"),
+    "generate": ("deeplearning4j_tpu.parallel", "generate"),
+    "load_source": ("deeplearning4j_tpu.ml", "load_source"),
+    "Evaluation": ("deeplearning4j_tpu.evaluation", "Evaluation"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'deeplearning4j_tpu' has no attribute "
+                         f"{name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

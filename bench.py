@@ -49,6 +49,18 @@ RECORD_METRIC = "LeNet-MNIST train examples/sec/chip"
 # timing helper
 # ---------------------------------------------------------------------------
 
+def _staged(*arrays):
+    """Stage batch data on the device ONCE before timing.  The throughput
+    rows measure the train step, not host->device transfer (BASELINE.md
+    procedure); re-uploading identical batches every step would both skew
+    the number and crawl through the axon tunnel's low-bandwidth relay."""
+    import jax
+
+    out = jax.device_put(arrays)
+    jax.block_until_ready(out)
+    return out
+
+
 def _time_steps(step_fn, warmup: int, steps: int) -> float:
     """Median seconds/step over windows of up to 10 steps; step_fn must
     return a device array (blocked on per window, so steps pipeline)."""
@@ -102,8 +114,8 @@ def bench_lenet() -> dict:
     net = MultiLayerNetwork(
         lenet_mnist(updater="sgd", compute_dtype=dtype)).init()
     rng = np.random.default_rng(0)
-    x = np.asarray(rng.random((BATCH, 28, 28, 1), dtype=np.float32))
-    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)]
+    x, y = _staged(rng.random((BATCH, 28, 28, 1), dtype=np.float32),
+                   np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)])
     sec = _time_steps(lambda: net.fit_batch_async(x, y), WARMUP, STEPS)
     flops = BATCH * _lenet_train_flops_per_example()
     return {"metric": RECORD_METRIC, "value": round(BATCH / sec, 1),
@@ -127,7 +139,7 @@ def bench_iris() -> dict:
 
     ds = iris_dataset()
     net = MultiLayerNetwork(iris_mlp()).init()
-    x, y = np.asarray(ds.features), np.asarray(ds.labels)
+    x, y = _staged(np.asarray(ds.features), np.asarray(ds.labels))
     sec = _time_steps(lambda: net.fit_batch_async(x, y), WARMUP,
                       max(60, STEPS))
     f1 = net.evaluate(x, y).f1()
@@ -166,8 +178,8 @@ def bench_lstm() -> dict:
     net = MultiLayerNetwork(char_lstm(vocab_size=V, hidden=H)).init()
     rng = np.random.default_rng(0)
     ids = rng.integers(0, V, (B, T))
-    x = np.eye(V, dtype=np.float32)[ids]
-    y = np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    x, y = _staged(np.eye(V, dtype=np.float32)[ids],
+                   np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)])
     steps = max(20, STEPS // 2)
     sec = _time_steps(lambda: net.fit_batch_async(x, y), WARMUP, steps)
     # per-timestep MACs: input proj V*4H + recurrent H*4H + head H*V
@@ -235,6 +247,8 @@ def bench_scaling() -> dict:
         b = per_chip * n_dev
         x = np.asarray(rng.random((b, 32, 32, 3), dtype=np.float32))
         y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, b)]
+        if n_dev == 1:  # DP trainer shards host arrays itself
+            x, y = _staged(x, y)
         sec = _time_steps(lambda: fit(x, y), WARMUP, max(30, STEPS // 2))
         return b / sec
 
@@ -357,24 +371,39 @@ BENCHES = {
 # baseline pinning
 # ---------------------------------------------------------------------------
 
-def _apply_baselines(results: list, canonical: bool) -> None:
+def _apply_baselines(results: list, canonical: bool,
+                     backend: str = None) -> None:
+    """Pin per-(metric, backend) baselines and fill vs_baseline.
+
+    Ratios are only ever computed within one backend: a CPU run never
+    compares against a TPU pin or vice versa, and — because pins are
+    keyed by backend, not overwritten on backend change — a CPU-fallback
+    canonical run during a tunnel outage cannot destroy the TPU pin (the
+    next TPU run still ratios against the original TPU baseline)."""
     path = REPO / ".bench_baseline.json"
-    pinned = {}
+    pinned: dict = {}
     if path.exists():
         data = json.loads(path.read_text())
-        if "pinned" in data:
-            pinned = data["pinned"]
-        elif "metric" in data:  # legacy single-metric format
-            pinned = {data["metric"]: data["value"]}
+        for metric, entry in data.get("pinned", {}).items():
+            if isinstance(entry, dict) and "value" in entry:
+                # transitional single-slot {value, backend} format
+                pinned[metric] = {entry.get("backend") or "unknown":
+                                  entry["value"]}
+            elif isinstance(entry, dict):
+                pinned[metric] = dict(entry)  # backend -> value
+            else:  # legacy bare number: backend unknown
+                pinned[metric] = {"unknown": entry}
+    key = backend or "unknown"
     changed = False
     for r in results:
         if r.get("value") is None:
             r["vs_baseline"] = None
             continue
-        if r["metric"] not in pinned and canonical:
-            pinned[r["metric"]] = r["value"]
+        per_backend = pinned.setdefault(r["metric"], {})
+        if key not in per_backend and canonical:
+            per_backend[key] = r["value"]
             changed = True
-        base = pinned.get(r["metric"], r["value"])
+        base = per_backend.get(key, r["value"] if not canonical else None)
         r["vs_baseline"] = round(r["value"] / base, 3) if base else None
     if changed:
         path.write_text(json.dumps(
@@ -419,7 +448,7 @@ def run_suite() -> int:
         if backend is not None:
             r.setdefault("backend", backend)
         results.append(r)
-        _apply_baselines(results, canonical)
+        _apply_baselines(results, canonical, backend)
         print(json.dumps(r), file=sys.stderr, flush=True)
         try:  # progressive write to a SIDECAR: a later hang must not lose
             # earlier rows, but a dying run must not clobber the last

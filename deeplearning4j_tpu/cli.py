@@ -121,17 +121,32 @@ def cmd_train(args) -> int:
         divisor = runner.n_devices
     else:
         runner = net
+    from deeplearning4j_tpu.datasets.iterators import PrefetchDataSetIterator
+
+    def _batches():
+        for epoch in range(epochs):
+            for b in ds.shuffle(seed=epoch).batch_by(batch):
+                n = b.num_examples()
+                if n % divisor:
+                    # SPMD shards the batch over the mesh; pad the tail
+                    # batch by wrapping so every shard stays equally sized.
+                    reps = (-n) % divisor
+                    idx = np.concatenate([np.arange(n),
+                                          np.arange(reps) % n])
+                    b = type(b)(b.features[idx], b.labels[idx])
+                yield b
+
     t0 = time.time()
-    for epoch in range(epochs):
-        for b in ds.shuffle(seed=epoch).batch_by(batch):
-            n = b.num_examples()
-            if n % divisor:
-                # SPMD shards the batch over the mesh; pad the tail batch
-                # by wrapping so every shard stays equally sized.
-                reps = (-n) % divisor
-                idx = np.concatenate([np.arange(n), np.arange(reps) % n])
-                b = type(b)(b.features[idx], b.labels[idx])
-            runner.fit_batch(b.features, b.labels)
+    # Prefetch shuffles/slices/pads batch b+1 on a host thread while the
+    # device trains on b; async stepping lets the device pipeline steps
+    # (host syncs once at evaluation below).
+    last = None
+    for b in PrefetchDataSetIterator(_batches()):
+        last = runner.fit_batch_async(b.features, b.labels)
+    if last is not None:
+        import jax
+
+        jax.block_until_ready(last)
     elapsed = time.time() - t0
 
     out = pathlib.Path(args.output or "dl4j-output")

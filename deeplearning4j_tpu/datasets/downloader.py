@@ -147,8 +147,14 @@ def fetch_cifar10(dest: Optional[Path] = None) -> Path:
         raise RuntimeError("CIFAR-10 download forbidden (DL4J_NO_DOWNLOAD)")
     archive = root / "cifar-10-python.tar.gz"
     url = os.environ.get("CIFAR10_URL", CIFAR10_URL)
-    download(url, archive, sha256=None if "CIFAR10_URL" in os.environ
-             else CIFAR10_SHA256)
+    # The sha256 pin applies to the canonical archive; only a genuinely
+    # different mirror skips it, and loudly — never silently.
+    sha = CIFAR10_SHA256 if url == CIFAR10_URL else None
+    if sha is None:
+        warnings.warn(
+            f"CIFAR10_URL override ({url}): sha256 verification DISABLED "
+            "for this download", stacklevel=2)
+    download(url, archive, sha256=sha)
     tmp = root / ".extract.tmp"
     if tmp.exists():
         shutil.rmtree(tmp)

@@ -2,13 +2,20 @@
 
 Parity: reference `datasets/fetchers/*` (MNIST `MnistDataFetcher.java:39`,
 Iris `IrisDataFetcher`, Curves, LFW, CSV) and the Canova record-reader bridge
-(`RecordReaderDataSetIterator`). This environment has no network egress, so:
+(`RecordReaderDataSetIterator`).
 
 - Iris comes from sklearn's bundled copy (same 150-example dataset the
   reference ships in dl4j-test-resources).
-- `mnist_dataset()` loads a real MNIST IDX directory if one is present
-  (MNIST_DIR env var), else falls back to sklearn's 8x8 digits upscaled to
-  28x28, else synthetic — callers get MNIST-shaped data either way.
+- `mnist_dataset()` resolves, in order: an IDX directory (MNIST_DIR), the
+  download cache, a live download via `datasets.downloader` (sha256-pinned
+  mirrors, reference MnistFetcher.java:48), and only then a LOUD fallback
+  (sklearn 8x8 digits upscaled, else synthetic) so offline environments
+  still get MNIST-shaped data.  `is_real_mnist_available()` tells quality
+  gates whether the returned data is the real thing.
+- `digits_dataset()` is the always-available REAL fixture (sklearn's
+  bundled 1,797-image 8x8 handwritten digits) for convergence gates that
+  must run even fully offline — the role dl4j-test-resources' bundled
+  mnist2500 fixture plays for the reference's tests.
 - CSV / SVMLight readers replace the Canova record-reader path used by the
   CLI (reference Train.java:155-165, default SVMLightInputFormat).
 """
@@ -123,6 +130,29 @@ def is_real_mnist_available() -> bool:
     dirs.append(downloader.cache_dir("mnist"))
     return any(
         _load_mnist_dir(d, "test", False, False) is not None for d in dirs)
+
+
+def digits_dataset(split: str = "train", flatten: bool = False) -> DataSet:
+    """REAL handwritten-digit data that is always available offline:
+    sklearn's bundled UCI optical-digits set (1,797 images, 8x8, 10
+    classes).  This is the offline stand-in for the reference's bundled
+    mnist2500 fixture (dl4j-test-resources) — convergence/quality gates
+    run against it unconditionally, while the full-size MNIST gates run
+    whenever `is_real_mnist_available()`.
+
+    Deterministic shuffled 80/20 split; features in [0,1], NHWC
+    [N,8,8,1] (or flat [N,64])."""
+    from sklearn.datasets import load_digits
+
+    digits = load_digits()
+    order = np.random.default_rng(42).permutation(len(digits.target))
+    images = digits.images.astype(np.float32)[order] / 16.0
+    labels = digits.target[order]
+    cut = int(len(order) * 0.8)
+    sl = slice(0, cut) if split == "train" else slice(cut, None)
+    part = images[sl]
+    x = part.reshape(len(part), -1) if flatten else part[..., None]
+    return DataSet(x, one_hot(labels[sl], 10))
 
 
 def lfw_dataset(min_faces_per_person: int = 20, resize: float = 0.4,

@@ -9,11 +9,12 @@ from deeplearning4j_tpu.models.zoo import (
     char_lstm,
     get_model,
     iris_mlp,
+    lenet_digits,
     lenet_mnist,
 )
 
 __all__ = [
     "MultiLayerNetwork", "RNTN", "RNTNEval", "RecursiveAutoEncoder",
-    "ZOO", "get_model", "lenet_mnist", "alexnet_cifar10", "char_lstm",
-    "iris_mlp",
+    "ZOO", "get_model", "lenet_mnist", "lenet_digits", "alexnet_cifar10",
+    "char_lstm", "iris_mlp",
 ]

@@ -102,6 +102,7 @@ class MultiLayerNetwork:
         self._listeners: list = []
         self._jit_train_step = None
         self._jit_forward = None
+        self._jit_score = None
         self._iteration = 0
 
     # ---- construction -----------------------------------------------------
@@ -326,26 +327,22 @@ class MultiLayerNetwork:
                 maximize=not cfg.minimize,
                 max_line_iters=cfg.max_num_line_search_iterations)
 
+        # ONE solver (and ONE compiled step) per distinct batch SHAPE —
+        # the batch is a traced argument of the solver step, so iterating
+        # epochs x minibatches never recompiles (reference keeps one
+        # optimizer object per fit, BaseOptimizer.java:124).  Full-batch
+        # data is simply the single-shape case.
         batches = list(_as_batches(data))
-        if len(batches) == 1:
-            # Full-batch training — the solvers' natural regime (reference
-            # LBFGS/CG/HF are full-batch): ONE solver, one compile, reused
-            # across epochs.
-            x, y, mask = batches[0]
-            solver = make_solver(x, y, mask)
-            for _ in range(epochs):
-                solver._x0 = self.params_flat()
-                loss = solver.fit_model()
-                self._iteration += 1
-                for listener in self._listeners:
-                    listener(self._iteration, float(loss))
-            return self
-        # Mini-batched data: each batch is a distinct objective, so a
-        # fresh solver (and compile) per batch is inherent to the
-        # algorithm class — prefer a single full batch with these solvers.
+        solvers: Dict[tuple, Any] = {}
         for _ in range(epochs):
             for x, y, mask in batches:
-                loss = make_solver(x, y, mask).fit_model()
+                key = (np.shape(x), np.shape(y),
+                       None if mask is None else np.shape(mask))
+                solver = solvers.get(key)
+                if solver is None:
+                    solver = solvers[key] = make_solver(x, y, mask)
+                solver._x0 = self.params_flat()
+                loss = solver.fit_model(x, y, mask)
                 self._iteration += 1
                 for listener in self._listeners:
                     listener(self._iteration, float(loss))
@@ -422,13 +419,17 @@ class MultiLayerNetwork:
         return np.asarray(self.output(x, mask))
 
     def score(self, x, y, mask=None) -> float:
-        """Loss on a dataset (reference score() :1391)."""
+        """Loss on a dataset (reference score() :1391). Jitted and cached:
+        repeated scoring (CLI `test`, eval loops) compiles once per shape."""
         if self.params is None:
             self.init()
-        loss, _ = self._objective(self.params, self.state, jnp.asarray(x),
-                                  jnp.asarray(y), rng=None,
-                                  mask=None if mask is None else jnp.asarray(mask))
-        return float(loss)
+        if self._jit_score is None:
+            self._jit_score = jax.jit(
+                lambda p, s, x, y, mask: self._objective(
+                    p, s, x, y, rng=None, mask=mask)[0])
+        return float(self._jit_score(
+            self.params, self.state, jnp.asarray(x), jnp.asarray(y),
+            None if mask is None else jnp.asarray(mask)))
 
     def evaluate(self, x, y, mask=None):
         from deeplearning4j_tpu.evaluation import Evaluation

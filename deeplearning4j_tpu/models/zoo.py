@@ -93,6 +93,32 @@ def alexnet_cifar10(updater: str = "sgd", learning_rate: float = 0.01,
     )
 
 
+def lenet_digits(updater: str = "adam", learning_rate: float = 0.01,
+                 seed: int = 0, compute_dtype: str = "float32"
+                 ) -> MultiLayerConfiguration:
+    """LeNet-style conv net for the 8x8x1 REAL digits fixture
+    (`datasets.fetchers.digits_dataset`) — the offline convergence-gate
+    model (the role the reference's bundled-fixture tests play,
+    `MultiLayerTest.java:120`): Conv(16,3x3) -> pool -> Conv(32,3x3) ->
+    pool -> 64 -> 10 on an 8 -> 4 -> 2 spatial pyramid."""
+    return MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=learning_rate,
+                                    updater=updater, seed=seed,
+                                    compute_dtype=compute_dtype),
+        layers=(
+            ConvolutionLayerConf(n_in=1, n_out=16, kernel_size=(3, 3),
+                                 padding="SAME"),
+            SubsamplingLayerConf(),
+            ConvolutionLayerConf(n_in=16, n_out=32, kernel_size=(3, 3),
+                                 padding="SAME"),
+            SubsamplingLayerConf(),
+            DenseLayerConf(n_in=128, n_out=64, activation="relu"),
+            OutputLayerConf(n_in=64, n_out=10),
+        ),
+        input_preprocessors={"4": {"type": "cnn_to_ffn"}},
+    )
+
+
 def char_lstm(vocab_size: int = 80, hidden: int = 256,
               updater: str = "adam", learning_rate: float = 0.01,
               seed: int = 0) -> MultiLayerConfiguration:
@@ -121,6 +147,7 @@ def iris_mlp(updater: str = "adam", learning_rate: float = 0.02,
 
 ZOO = {
     "lenet-mnist": lenet_mnist,
+    "lenet-digits": lenet_digits,
     "alexnet-cifar10": alexnet_cifar10,
     "char-lstm": char_lstm,
     "iris-mlp": iris_mlp,

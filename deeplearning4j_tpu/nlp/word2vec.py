@@ -20,10 +20,14 @@ sparse per-pair saxpy updates, racy across a thread pool (Hogwild). Here:
 - gradients reach syn0/syn1 through XLA's gather→scatter-add autodiff:
   the update is mathematically the reference's sparse saxpy, but batched,
   deterministic, and fused by the compiler;
-- Hogwild's lock-free parallelism maps to data-parallel batch sharding —
-  shard the pair stream over the mesh and psum the gradients
-  (`parallel.data_parallel` pattern), which is *more* synchronous than the
-  reference, not less.
+- Hogwild's lock-free parallelism (`Word2Vec.java:145-258` thread pool
+  over shared syn0, `InMemoryLookupTable.java:192`) maps to data-parallel
+  batch sharding: pass ``mesh=`` and each step shard_maps the pair batch
+  over the mesh's data axis, psums the syn0/syn1 gradients over ICI, and
+  applies one identical update per replica — *more* synchronous than the
+  reference's racy updates, not less, and bit-stable across device counts
+  up to float reduction order.  ``mesh=None`` is the single-device case
+  with identical numerics (the psum of one shard).
 """
 
 from __future__ import annotations
@@ -34,6 +38,22 @@ from typing import Iterable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+
+    def _smap(f, *, mesh, in_specs, out_specs):
+        # jax>=0.8 renamed check_rep -> check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _smap(f, *, mesh, in_specs, out_specs):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
 
 from deeplearning4j_tpu.nlp.tokenization import (
     DefaultTokenizerFactory,
@@ -69,13 +89,18 @@ class Word2Vec(WordVectors):
                  batch_size: int = 2048,
                  epochs: int = 1,
                  seed: int = 42,
-                 tokenizer_factory: Optional[TokenizerFactory] = None):
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 mesh=None):
         self.vector_length = vector_length
         self.window = window
         self.learning_rate = learning_rate
         self.min_learning_rate = min_learning_rate
         self.negative = negative
         self.subsample = subsample
+        self.mesh = mesh  # jax.sharding.Mesh: shard pairs over its 1st axis
+        if mesh is not None:
+            n = mesh.devices.size
+            batch_size = ((batch_size + n - 1) // n) * n  # divisible shards
         self.batch_size = batch_size
         self.epochs = epochs
         self.seed = seed
@@ -177,8 +202,7 @@ class Word2Vec(WordVectors):
         points, codes, lengths = self._hs
         L = points.shape[1]
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def hs_step(syn0, syn1, inputs, targets, lr, key, valid):
+        def grads(syn0, syn1, inputs, targets, valid):
             def loss_fn(s0, s1):
                 h = s0[inputs]                   # [B, D] input vectors
                 p = points[targets]              # [B, L] inner-node path
@@ -194,6 +218,13 @@ class Word2Vec(WordVectors):
 
             loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
                 syn0, syn1)
+            return loss, g0, g1
+
+        grads = self._maybe_shard(grads, with_key=False)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def hs_step(syn0, syn1, inputs, targets, lr, key, valid):
+            loss, g0, g1 = grads(syn0, syn1, inputs, targets, valid)
             return syn0 - lr * g0, syn1 - lr * g1, loss
 
         return hs_step
@@ -203,10 +234,8 @@ class Word2Vec(WordVectors):
         table = self._neg_table
         T = table.shape[0]
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def neg_step(syn0, syn1neg, inputs, targets, lr, key, valid):
-            B = inputs.shape[0]
-            idx = jax.random.randint(key, (B, K), 0, T)
+        def grads(syn0, syn1neg, inputs, targets, valid, key):
+            idx = jax.random.randint(key, (inputs.shape[0], K), 0, T)
             negs = table[idx]                    # [B, K]
 
             def loss_fn(s0, s1n):
@@ -224,9 +253,45 @@ class Word2Vec(WordVectors):
 
             loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
                 syn0, syn1neg)
+            return loss, g0, g1
+
+        grads = self._maybe_shard(grads, with_key=True)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def neg_step(syn0, syn1neg, inputs, targets, lr, key, valid):
+            loss, g0, g1 = grads(syn0, syn1neg, inputs, targets, valid, key)
             return syn0 - lr * g0, syn1neg - lr * g1, loss
 
         return neg_step
+
+    def _maybe_shard(self, grads_fn, with_key: bool):
+        """Mesh-parallel training step core (the documented TPU-native
+        Hogwild, `Word2Vec.java:145-258`): shard the pair batch over the
+        mesh's first axis, keep syn0/syn1 replicated, psum gradients and
+        loss over ICI so every replica applies one identical update.
+        mesh=None returns the fn unwrapped — the exact single-device
+        numerics (a one-shard psum)."""
+        if self.mesh is None:
+            return grads_fn
+        mesh, axis = self.mesh, self.mesh.axis_names[0]
+
+        if with_key:
+            def local(s0, s1, inputs, targets, valid, key):
+                key = jax.random.fold_in(key, lax.axis_index(axis))
+                loss, g0, g1 = grads_fn(s0, s1, inputs, targets, valid, key)
+                return (lax.psum(loss, axis), lax.psum(g0, axis),
+                        lax.psum(g1, axis))
+
+            in_specs = (P(), P(), P(axis), P(axis), P(axis), P())
+        else:
+            def local(s0, s1, inputs, targets, valid):
+                loss, g0, g1 = grads_fn(s0, s1, inputs, targets, valid)
+                return (lax.psum(loss, axis), lax.psum(g0, axis),
+                        lax.psum(g1, axis))
+
+            in_specs = (P(), P(), P(axis), P(axis), P(axis))
+        return _smap(local, mesh=mesh, in_specs=in_specs,
+                     out_specs=(P(), P(), P()))
 
     # ------------------------------------------------------------------
     # fit (reference Word2Vec.fit():103)

@@ -62,9 +62,15 @@ class NeuralNetConfiguration:
                 f"other reference StepFunctions have no analog")
         algos = ("stochastic_gradient_descent", "line_gradient_descent",
                  "conjugate_gradient", "lbfgs", "hessian_free")
-        if self.optimization_algo not in algos:
-            raise ValueError(f"optimization_algo="
-                             f"{self.optimization_algo!r}; known: {algos}")
+        algo = self.optimization_algo
+        algo = getattr(algo, "value", algo)  # accept the str enum member
+        if algo == "sgd":  # OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
+            # has value 'sgd'; accept both spellings.
+            algo = "stochastic_gradient_descent"
+        if algo is not self.optimization_algo:
+            object.__setattr__(self, "optimization_algo", algo)
+        if algo not in algos:
+            raise ValueError(f"optimization_algo={algo!r}; known: {algos}")
 
     def updater_config(self) -> UpdaterConfig:
         return UpdaterConfig(

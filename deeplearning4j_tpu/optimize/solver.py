@@ -57,7 +57,7 @@ class Solver:
         self._sign = -1.0 if maximize else 1.0
         if maximize:  # reference `minimize` flag: maximize f == minimize -f
             orig = f
-            f = lambda v: -orig(v)  # noqa: E731
+            f = lambda v, *data: -orig(v, *data)  # noqa: E731
         self.f = f
         self.algorithm = OptimizationAlgorithm(algorithm)
         self.num_iterations = num_iterations
@@ -70,11 +70,14 @@ class Solver:
         self._step = jax.jit(step)
 
     # -- reference Solver.optimize() ---------------------------------------
-    def optimize(self, x0) -> np.ndarray:
-        state = self._init(jnp.asarray(x0))
+    def optimize(self, x0, *data) -> np.ndarray:
+        """Minimize from x0.  `data` (if any) are extra traced arguments
+        forwarded to the objective — re-invoking with same-shaped data
+        reuses the compiled step (no retrace)."""
+        state = self._init(jnp.asarray(x0), *data)
         f_old = float(state.fval)
         for i in range(self.num_iterations):
-            state = self._step(state)
+            state = self._step(state, *data)
             f_new = float(state.fval)
             for listener in self.listeners:
                 # report the USER's objective: un-negate under maximize
@@ -95,28 +98,57 @@ class Solver:
     # -- model adapter ------------------------------------------------------
     @classmethod
     def for_model(cls, net, x, y, mask=None, **kwargs) -> "Solver":
-        """Adapt a MultiLayerNetwork + fixed batch into a flat objective, so
-        full-batch solvers (LBFGS/CG/HF) can train it — the reference's
-        per-layer Solver usage (`BaseLayer.getOptimizer():244-252`)."""
+        """Adapt a MultiLayerNetwork into a flat objective, so full-batch
+        solvers (LBFGS/CG/HF) can train it — the reference's per-layer
+        Solver usage (`BaseLayer.getOptimizer():244-252`).
+
+        The batch AND the layer state enter the objective as traced data
+        arguments, so `fit_model(x2, y2)` on a same-shaped batch reuses the
+        compiled step (reference keeps one optimizer per fit,
+        `BaseOptimizer.java:124`) and stateful layers (batch-norm) see the
+        CURRENT running statistics on every call, not the ones captured at
+        construction."""
         from jax.flatten_util import ravel_pytree
 
         flat0, unravel = ravel_pytree(net.params)
-        state = net.state
-        xj, yj = jnp.asarray(x), jnp.asarray(y)
         rng = jax.random.PRNGKey(0)
 
-        def f(vec):
-            loss, _ = net._objective(unravel(vec), state, xj, yj, rng, mask)
+        def f(vec, xb, yb, maskb, state):
+            loss, _ = net._objective(unravel(vec), state, xb, yb, rng, maskb)
             return loss
 
         solver = cls(f, model=net, **kwargs)
         solver._x0 = np.asarray(flat0)
         solver._unravel = unravel
+        solver._bound = (jnp.asarray(x), jnp.asarray(y),
+                         None if mask is None else jnp.asarray(mask))
+        solver._state_advance = None
         return solver
 
-    def fit_model(self) -> float:
+    def fit_model(self, x=None, y=None, mask=None) -> float:
         """Run optimize() from the model's current params and write the
-        result back into the model. Returns the final score."""
-        best = self.optimize(self._x0)
-        self.model.params = self._unravel(jnp.asarray(best))
+        result back into the model. Returns the final score.
+
+        With arguments, optimizes over that batch (same shapes reuse the
+        compiled step); without, uses the batch bound at for_model time."""
+        net = self.model
+        if x is None:
+            x, y, mask = self._bound
+        else:
+            x = jnp.asarray(x)
+            y = jnp.asarray(y)
+            mask = None if mask is None else jnp.asarray(mask)
+        best = self.optimize(self._x0, x, y, mask, net.state)
+        net.params = self._unravel(jnp.asarray(best))
+        if any(s for s in net.state):  # stateful layers (e.g. batch-norm):
+            # advance running statistics once per solve — the objective is
+            # pure in them, so they would otherwise never update. Jitted
+            # and cached: one compile per shape, not an eager forward per
+            # solve.
+            if self._state_advance is None:
+                self._state_advance = jax.jit(
+                    lambda p, s, xb, yb, mb: net._objective(
+                        p, s, xb, yb, jax.random.PRNGKey(0), mb)[1])
+            net.state = self._state_advance(net.params, net.state, x, y,
+                                            mask)
         return float(self._sign * self.final_state.fval)

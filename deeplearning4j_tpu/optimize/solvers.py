@@ -33,8 +33,13 @@ class SolverState(NamedTuple):
     aux: Any  # algorithm-specific carried state (pytree)
 
 
-Algorithm = Tuple[Callable[[jax.Array], SolverState],
-                  Callable[[SolverState], SolverState]]
+# init(x0, *data) / step(state, *data): `data` are extra traced arguments
+# forwarded to the objective `f(x, *data)`.  Binding the minibatch as data
+# (instead of closing over it) lets ONE compiled step serve every batch of
+# the same shape — the reference keeps one optimizer object per fit
+# (BaseOptimizer.java:124); a compile per minibatch would not.
+Algorithm = Tuple[Callable[..., SolverState],
+                  Callable[..., SolverState]]
 
 
 def _value_grad(f):
@@ -47,13 +52,13 @@ def _value_grad(f):
 def stochastic_gradient_descent(f, learning_rate: float = 1e-1) -> Algorithm:
     vg = _value_grad(f)
 
-    def init(x0):
-        f0, g0 = vg(x0)
+    def init(x0, *data):
+        f0, g0 = vg(x0, *data)
         return SolverState(x0, f0, g0, jnp.zeros((), jnp.int32), ())
 
-    def step(s: SolverState) -> SolverState:
+    def step(s: SolverState, *data) -> SolverState:
         x = s.x - learning_rate * s.grad
-        fval, grad = vg(x)
+        fval, grad = vg(x, *data)
         return SolverState(x, fval, grad, s.it + 1, ())
 
     return init, step
@@ -67,20 +72,21 @@ def line_gradient_descent(f, max_line_iters: int = 10,
                           initial_step: float = 1.0) -> Algorithm:
     vg = _value_grad(f)
 
-    def init(x0):
-        f0, g0 = vg(x0)
+    def init(x0, *data):
+        f0, g0 = vg(x0, *data)
         return SolverState(x0, f0, g0, jnp.zeros((), jnp.int32), ())
 
-    def step(s: SolverState) -> SolverState:
+    def step(s: SolverState, *data) -> SolverState:
+        fd = lambda v: f(v, *data)  # noqa: E731
         direction = -s.grad
-        res = backtrack_line_search(f, s.x, s.fval, s.grad, direction,
+        res = backtrack_line_search(fd, s.x, s.fval, s.grad, direction,
                                     max_iterations=max_line_iters,
                                     initial_step=initial_step)
         moved = res.step > 0
         # If the search failed, take a tiny safeguarded gradient step so the
         # solver cannot stall forever (ref BaseOptimizer guards).
         x = jnp.where(moved, res.x_new, s.x - 1e-6 * s.grad)
-        fval, grad = vg(x)
+        fval, grad = vg(x, *data)
         return SolverState(x, fval, grad, s.it + 1, ())
 
     return init, step
@@ -97,18 +103,19 @@ class _CGAux(NamedTuple):
 def conjugate_gradient(f, max_line_iters: int = 10) -> Algorithm:
     vg = _value_grad(f)
 
-    def init(x0):
-        f0, g0 = vg(x0)
+    def init(x0, *data):
+        f0, g0 = vg(x0, *data)
         return SolverState(x0, f0, g0, jnp.zeros((), jnp.int32),
                            _CGAux(direction=-g0, g_prev=g0))
 
-    def step(s: SolverState) -> SolverState:
+    def step(s: SolverState, *data) -> SolverState:
+        fd = lambda v: f(v, *data)  # noqa: E731
         aux: _CGAux = s.aux
-        res = backtrack_line_search(f, s.x, s.fval, s.grad, aux.direction,
+        res = backtrack_line_search(fd, s.x, s.fval, s.grad, aux.direction,
                                     max_iterations=max_line_iters)
         moved = res.step > 0
         x = jnp.where(moved, res.x_new, s.x - 1e-6 * s.grad)
-        fval, grad = vg(x)
+        fval, grad = vg(x, *data)
         # Polak-Ribiere beta, clamped at 0 (automatic restart).
         denom = jnp.maximum(jnp.vdot(aux.g_prev, aux.g_prev), 1e-30)
         beta = jnp.maximum(jnp.vdot(grad, grad - aux.g_prev) / denom, 0.0)
@@ -135,8 +142,8 @@ class _LbfgsAux(NamedTuple):
 def lbfgs(f, m: int = 4, max_line_iters: int = 16) -> Algorithm:
     vg = _value_grad(f)
 
-    def init(x0):
-        f0, g0 = vg(x0)
+    def init(x0, *data):
+        f0, g0 = vg(x0, *data)
         n = x0.shape[0]
         aux = _LbfgsAux(S=jnp.zeros((m, n), x0.dtype),
                         Y=jnp.zeros((m, n), x0.dtype),
@@ -178,16 +185,17 @@ def lbfgs(f, m: int = 4, max_line_iters: int = 16) -> Algorithm:
         r = lax.fori_loop(0, m, fwd, r)
         return -r
 
-    def step(s: SolverState) -> SolverState:
+    def step(s: SolverState, *data) -> SolverState:
+        fd = lambda v: f(v, *data)  # noqa: E731
         aux: _LbfgsAux = s.aux
         direction = two_loop(aux, s.grad)
         descent = jnp.vdot(s.grad, direction) < 0
         direction = jnp.where(descent, direction, -s.grad)
-        res = backtrack_line_search(f, s.x, s.fval, s.grad, direction,
+        res = backtrack_line_search(fd, s.x, s.fval, s.grad, direction,
                                     max_iterations=max_line_iters)
         moved = res.step > 0
         x = jnp.where(moved, res.x_new, s.x - 1e-6 * s.grad)
-        fval, grad = vg(x)
+        fval, grad = vg(x, *data)
         s_vec = x - s.x
         y_vec = grad - s.grad
         sy = jnp.vdot(s_vec, y_vec)
@@ -220,15 +228,15 @@ def hessian_free(f, cg_iters: int = 20, initial_damping: float = 1.0,
     vg = _value_grad(f)
     grad_f = jax.grad(f)
 
-    def hvp(x, v):
-        return jax.jvp(grad_f, (x,), (v,))[1]
+    def hvp(x, v, *data):
+        return jax.jvp(lambda xx: grad_f(xx, *data), (x,), (v,))[1]
 
-    def cg_solve(x, g, lam):
+    def cg_solve(x, g, lam, *data):
         """Linear CG for (H + lam I) d = -g, `cg_iters` fixed iterations."""
         b = -g
 
         def mv(v):
-            return hvp(x, v) + lam * v
+            return hvp(x, v, *data) + lam * v
 
         d0 = jnp.zeros_like(b)
         r0 = b  # b - A@0
@@ -248,25 +256,26 @@ def hessian_free(f, cg_iters: int = 20, initial_damping: float = 1.0,
                               (d0, r0, p0, jnp.vdot(r0, r0)))
         return d
 
-    def init(x0):
-        f0, g0 = vg(x0)
+    def init(x0, *data):
+        f0, g0 = vg(x0, *data)
         return SolverState(x0, f0, g0, jnp.zeros((), jnp.int32),
                            _HFAux(lam=jnp.asarray(initial_damping, x0.dtype)))
 
-    def step(s: SolverState) -> SolverState:
+    def step(s: SolverState, *data) -> SolverState:
+        fd = lambda v: f(v, *data)  # noqa: E731
         lam = s.aux.lam
-        direction = cg_solve(s.x, s.grad, lam)
+        direction = cg_solve(s.x, s.grad, lam, *data)
         descent = jnp.vdot(s.grad, direction) < 0
         direction = jnp.where(descent, direction, -s.grad)
-        res = backtrack_line_search(f, s.x, s.fval, s.grad, direction,
+        res = backtrack_line_search(fd, s.x, s.fval, s.grad, direction,
                                     max_iterations=max_line_iters)
         moved = res.step > 0
         x = jnp.where(moved, res.x_new, s.x - 1e-6 * s.grad)
-        fval, grad = vg(x)
+        fval, grad = vg(x, *data)
         # LM damping adaptation on the reduction ratio (ref rho heuristic):
         # predicted reduction from the local quadratic model.
         pred = -(jnp.vdot(s.grad, direction)
-                 + 0.5 * jnp.vdot(direction, hvp(s.x, direction)))
+                 + 0.5 * jnp.vdot(direction, hvp(s.x, direction, *data)))
         actual = s.fval - fval
         ratio = actual / jnp.maximum(jnp.abs(pred), 1e-30)
         lam = jnp.where(ratio > 0.75, lam * (2.0 / 3.0),
@@ -282,7 +291,7 @@ def hessian_free(f, cg_iters: int = 20, initial_damping: float = 1.0,
 # optimize/solver.py).
 
 def minimize(algorithm: Algorithm, x0: jax.Array, num_iterations: int,
-             tol: float = 0.0) -> SolverState:
+             tol: float = 0.0, *data) -> SolverState:
     """Run `num_iterations` solver steps inside one lax.while_loop; stops
     early when |f_prev - f| <= tol * max(1, |f_prev|) (ref EpsTermination)."""
     init, step = algorithm
@@ -293,7 +302,7 @@ def minimize(algorithm: Algorithm, x0: jax.Array, num_iterations: int,
 
     def body(carry):
         s, f_prev, _ = carry
-        s2 = step(s)
+        s2 = step(s, *data)
         improved = jnp.abs(f_prev - s2.fval) <= tol * jnp.maximum(
             1.0, jnp.abs(f_prev))
         # Guard: f_prev is only meaningful once we have a previous iterate.
@@ -301,7 +310,7 @@ def minimize(algorithm: Algorithm, x0: jax.Array, num_iterations: int,
                                jnp.logical_and(improved, tol > 0))
         return s2, s2.fval, stop
 
-    s0 = init(x0)
+    s0 = init(x0, *data)
     out, _, _ = lax.while_loop(
         cond, body, (s0, jnp.asarray(jnp.inf, s0.fval.dtype),
                      jnp.asarray(False)))

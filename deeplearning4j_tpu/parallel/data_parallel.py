@@ -167,11 +167,14 @@ class DataParallelTrainer:
 
     # ---- public API -------------------------------------------------------
 
-    def fit_batch(self, x, y, mask=None) -> float:
+    def fit_batch_async(self, x, y, mask=None):
         """One SPMD step over the global batch (dim 0 must be divisible by
-        the mesh's data-axis size).  sync_every==1: synchronous gradient
-        allreduce.  sync_every>1: local step per replica, params averaged
-        every N steps (net.params reflects the average at sync points)."""
+        the mesh's data-axis size); returns the loss as a DEVICE array
+        without synchronizing, so back-to-back steps pipeline (mirror of
+        MultiLayerNetwork.fit_batch_async).  sync_every==1: synchronous
+        gradient allreduce.  sync_every>1: local step per replica, params
+        averaged every N steps (net.params reflects the average at sync
+        points).  Listeners force a host sync only when registered."""
         net = self.net
         x = np.asarray(x)
         y = np.asarray(y)
@@ -198,10 +201,15 @@ class DataParallelTrainer:
         self._iteration += 1
         if self.sync_every > 1 and self._iteration % self.sync_every == 0:
             self._average_params()
-        loss_f = float(loss)
-        for listener in net._listeners:
-            listener(self._iteration, loss_f)
-        return loss_f
+        if net._listeners:
+            loss_f = float(loss)
+            for listener in net._listeners:
+                listener(self._iteration, loss_f)
+        return loss
+
+    def fit_batch(self, x, y, mask=None) -> float:
+        """fit_batch_async + host sync on the loss."""
+        return float(self.fit_batch_async(x, y, mask))
 
     def fit(self, data, epochs: int = 1) -> "DataParallelTrainer":
         for _ in range(epochs):

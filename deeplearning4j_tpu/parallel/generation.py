@@ -130,6 +130,9 @@ def generate(cfg: TransformerConfig, params: dict, prompt,
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     batch, plen = prompt.shape
+    if plen < 1:
+        raise ValueError("prompt must contain at least one token "
+                         "(the first sampled token conditions on it)")
     if plen + max_new_tokens > cfg.max_len:
         raise ValueError(f"prompt({plen}) + new({max_new_tokens}) exceeds "
                          f"max_len({cfg.max_len})")

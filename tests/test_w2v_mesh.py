@@ -1,0 +1,72 @@
+"""Mesh-parallel Word2Vec: N-device training matches single-device.
+
+The TPU-native replacement for the reference's Hogwild thread pool
+(`Word2Vec.java:145-258`, racy shared-memory syn0 updates): the pair
+batch is sharded over the mesh's data axis inside shard_map and the
+syn0/syn1 gradients are psum'd, so every replica applies one identical
+update (VERDICT r2 item 5).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.parallel import make_mesh
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """Two disjoint topic clusters (w0-49 vs w50-99): within-cluster words
+    share windows, cross-cluster words never do."""
+    rng = np.random.default_rng(0)
+    vocab = [f"w{i}" for i in range(100)]
+    sents = []
+    for k in range(400):
+        lo = 0 if k % 2 == 0 else 50
+        sents.append(" ".join(
+            vocab[lo + int(rng.integers(0, 50))] for _ in range(12)))
+    return sents
+
+
+def _train(corpus, mesh, negative, epochs=3, learning_rate=0.025):
+    w = Word2Vec(vector_length=32, window=3, negative=negative,
+                 epochs=epochs, learning_rate=learning_rate,
+                 batch_size=512, seed=7, mesh=mesh)
+    return w.fit(corpus)
+
+
+def test_hs_mesh_training_matches_single_device_exactly(corpus):
+    """Hierarchical softmax uses no per-shard randomness: psum of shard
+    gradients == full-batch gradient, so 4-device training reproduces
+    single-device weights bit-for-bit (up to reduction order)."""
+    mesh = make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    single = _train(corpus, None, negative=0)
+    sharded = _train(corpus, mesh, negative=0)
+    np.testing.assert_allclose(single.syn0, sharded.syn0, atol=1e-5)
+    np.testing.assert_allclose(single.syn1, sharded.syn1, atol=1e-5)
+
+
+def test_neg_mesh_training_converges_like_single_device(corpus):
+    """Negative sampling draws per-shard negatives (fold_in on the axis
+    index), so weights differ — but the learned similarity structure must
+    match: words sharing windows land close, distant words do not."""
+    mesh = make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    single = _train(corpus, None, negative=5, epochs=8, learning_rate=0.05)
+    sharded = _train(corpus, mesh, negative=5, epochs=8, learning_rate=0.05)
+    rng = np.random.default_rng(1)
+    for w2v in (single, sharded):
+        within = np.mean([w2v.similarity(f"w{a}", f"w{b}")
+                          for a, b in rng.integers(0, 50, (20, 2))])
+        across = np.mean([w2v.similarity(f"w{a}", f"w{b + 50}")
+                          for a, b in rng.integers(0, 50, (20, 2))])
+        assert within > across + 0.1, (within, across)
+    # The two runs agree on the ranking signal itself.
+    assert abs(single.similarity("w10", "w12")
+               - sharded.similarity("w10", "w12")) < 0.15
+
+
+def test_mesh_batch_size_rounds_up_to_shardable():
+    mesh = make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    w = Word2Vec(batch_size=1022, mesh=mesh)
+    assert w.batch_size % 4 == 0

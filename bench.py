@@ -169,32 +169,62 @@ def bench_iris() -> dict:
 
 def bench_lstm() -> dict:
     """#4: character-level LSTM LM (GravesLSTM.java:47 parity config) —
-    examples/sec/chip at batch 32, seq 64, vocab 80, hidden 256."""
+    examples/sec/chip at batch 32, seq 64, vocab 80, hidden 256.  On TPU
+    the lax.scan path is A/B'd against the Pallas fused-LSTM kernel
+    (`nn/layers/lstm_kernel.py`) and the faster one is the row value."""
     import jax
 
     from deeplearning4j_tpu.models import MultiLayerNetwork, char_lstm
 
     V, B, T, H = 80, 32, 64, 256
-    net = MultiLayerNetwork(char_lstm(vocab_size=V, hidden=H)).init()
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = "bfloat16" if on_tpu else "float32"
     rng = np.random.default_rng(0)
     ids = rng.integers(0, V, (B, T))
     x, y = _staged(np.eye(V, dtype=np.float32)[ids],
                    np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)])
     steps = max(20, STEPS // 2)
-    sec = _time_steps(lambda: net.fit_batch_async(x, y), WARMUP, steps)
+
+    def timed(fused: bool) -> float:
+        import dataclasses
+
+        conf = char_lstm(vocab_size=V, hidden=H, compute_dtype=dtype)
+        # Pin the path via the layer conf (no env/jit-cache interplay).
+        conf = dataclasses.replace(conf, layers=tuple(
+            dataclasses.replace(lc, fused=fused) if hasattr(lc, "fused")
+            else lc for lc in conf.layers))
+        net = MultiLayerNetwork(conf).init()
+        return _time_steps(lambda: net.fit_batch_async(x, y), WARMUP, steps)
+
+    sec_scan = timed(False)
+    result = {"path": "scan", "scan_ms": round(sec_scan * 1e3, 3)}
+    sec = sec_scan
+    if on_tpu:  # interpret-mode kernel off-TPU is not a perf path
+        try:
+            sec_fused = timed(True)
+            result["fused_ms"] = round(sec_fused * 1e3, 3)
+            if sec_fused < sec_scan:
+                sec, result["path"] = sec_fused, "fused-pallas"
+        except Exception as e:  # noqa: BLE001 - fused is optional
+            result["fused_error"] = f"{type(e).__name__}: {e}"
     # per-timestep MACs: input proj V*4H + recurrent H*4H + head H*V
     flops = 3.0 * 2 * B * T * (V * 4 * H + H * 4 * H + H * V)
-    on_tpu = jax.default_backend() == "tpu"
     return {"metric": "charLSTM train examples/sec/chip",
             "unit": "examples/sec", "value": round(B / sec, 1),
-            "batch": B, "seq_len": T, "step_ms": round(sec * 1e3, 3),
-            "mfu": round(flops / sec / _peak_flops(on_tpu), 5)}
+            "batch": B, "seq_len": T, "dtype": dtype,
+            "step_ms": round(sec * 1e3, 3),
+            "mfu": round(flops / sec / _peak_flops(on_tpu), 5), **result}
 
 
 def bench_word2vec() -> dict:
     """#3: Word2Vec skip-gram words/sec on a zipf-sampled synthetic corpus
-    (text8 is not fetchable offline; throughput is corpus-agnostic)."""
+    (text8 is not fetchable offline; throughput is corpus-agnostic).
+    With >1 visible device the mesh-parallel path (shard_map pair
+    sharding + psum'd grads) carries the training."""
+    import jax
+
     from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    from deeplearning4j_tpu.parallel import make_mesh
 
     rng = np.random.default_rng(0)
     vocab = [f"w{i}" for i in range(2000)]
@@ -207,8 +237,10 @@ def bench_word2vec() -> dict:
         n = int(rng.integers(8, 24))
         sentences.append(" ".join(vocab[i] for i in ids[k:k + n]))
         k += n
+    n_dev = len(jax.devices())
+    mesh = (make_mesh((n_dev,), ("data",)) if n_dev > 1 else None)
     w2v = Word2Vec(vector_length=128, window=5, negative=5, epochs=1,
-                   batch_size=4096)
+                   batch_size=4096, mesh=mesh)
     # Warmup fit triggers the one-time XLA compiles (identical shapes);
     # the timed fit is the steady-state throughput — on TPU a cold fit
     # would measure the ~25s compile, not the training.
@@ -218,6 +250,7 @@ def bench_word2vec() -> dict:
     sec = time.perf_counter() - t0
     return {"metric": "Word2Vec words/sec", "unit": "words/sec",
             "value": round(n_tokens / sec, 1), "tokens": n_tokens,
+            "devices": n_dev,
             "timing": "steady-state (post-compile)"}
 
 

@@ -121,12 +121,14 @@ def lenet_digits(updater: str = "adam", learning_rate: float = 0.01,
 
 def char_lstm(vocab_size: int = 80, hidden: int = 256,
               updater: str = "adam", learning_rate: float = 0.01,
-              seed: int = 0) -> MultiLayerConfiguration:
+              seed: int = 0, compute_dtype: str = "float32"
+              ) -> MultiLayerConfiguration:
     """Character-level LSTM language model (BASELINE.md config #4, the
     `GravesLSTM.java:47` parity workload)."""
     return MultiLayerConfiguration(
         conf=NeuralNetConfiguration(learning_rate=learning_rate,
-                                    updater=updater, seed=seed),
+                                    updater=updater, seed=seed,
+                                    compute_dtype=compute_dtype),
         layers=(GravesLSTMConf(n_in=vocab_size, n_out=hidden),
                 RnnOutputLayerConf(n_in=hidden, n_out=vocab_size)),
     )

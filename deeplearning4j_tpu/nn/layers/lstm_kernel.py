@@ -1,0 +1,215 @@
+"""Pallas fused LSTM scan: the whole time loop in ONE TPU kernel.
+
+Parity/perf target: the charLSTM baseline workload (BASELINE.md #4,
+reference `GravesLSTM.java:47`, whose hand-written Java BPTT loop this
+framework replaces with `lax.scan` in `nn/layers/recurrent.py`).  SURVEY
+§7 names the fused LSTM cell as the Pallas candidate once the scan
+dominates the step.
+
+Why a kernel beats the scan on TPU: inside `lax.scan` every timestep is a
+separate slice of the XLA while-loop body — the [H,4H] recurrent weights
+are re-read from HBM each step and the tiny [B,4H] gate intermediates
+round-trip through HBM.  Here the grid is the time axis (TPU grids run
+SEQUENTIALLY, which is exactly what a recurrence needs): the recurrent
+weights and the (h, c) carry live in VMEM scratch across all T grid
+steps, so steady state reads one [B,4H] input block and writes one
+[B,H] output block per step — everything else stays on-chip.
+
+Training support is a `jax.custom_vjp`: the forward kernel additionally
+writes the pre-activation gates `zs` and the cell states `cs` (the same
+caches the reference keeps as `ifogZs`/`ifogAs`, GravesLSTM.java:49-52),
+and the backward is a standard reverse-time BPTT scan over those saved
+activations — no forward recompute, no second Pallas kernel to validate.
+
+Used by `nn/layers/recurrent.py` when `fused_lstm_enabled()` (env
+`DL4J_TPU_FUSED_LSTM=1`, opt-in) and the fast-path conditions hold (no
+mask, tanh activation).  Off-TPU the kernel runs in Pallas interpret
+mode — tests compare forward AND gradients against the scan
+implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from jax.experimental.pallas import tpu as pltpu
+
+
+def fused_lstm_enabled() -> bool:
+    """Policy: opt-in via DL4J_TPU_FUSED_LSTM=1 (tests force-enable it in
+    interpret mode; `bench.py` A/Bs it against the scan on TPU).  Flips
+    to TPU-default once a real-chip run has validated the kernel — until
+    then the lax.scan path stays the default everywhere.
+
+    CAVEAT: the env flag is read at TRACE time; toggling it after a net
+    has compiled requires `jax.clear_caches()`.  Prefer the per-layer
+    config knob (`GravesLSTMConf(fused=True)`) — it lives in the layer
+    conf, so different settings are different models and can never see a
+    stale cache entry."""
+    return os.environ.get(
+        "DL4J_TPU_FUSED_LSTM", "").lower() in ("1", "true", "yes")
+
+
+def _resolve_interpret(interpret):
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+def _lstm_kernel(save_residuals, xz_ref, rw_ref, pi_ref, pf_ref, po_ref,
+                 hs_ref, *rest):
+    """One grid step = one timestep.  Refs: xz [1,B,4H] this step's input
+    projection (+bias); rw [H,4H]; peepholes [1,H]; output hs [1,B,H];
+    with save_residuals also cs [1,B,H] (f32) and zs [1,B,4H] (f32,
+    pre-peephole pre-activations) for the backward; scratch h_s/c_s
+    [B,H] f32 persist across the sequential grid."""
+    if save_residuals:
+        cs_ref, zs_ref, h_s, c_s = rest
+    else:
+        h_s, c_s = rest
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_s[...] = jnp.zeros_like(h_s)
+        c_s[...] = jnp.zeros_like(c_s)
+
+    h_prev = h_s[...]
+    c_prev = c_s[...]
+    n = h_prev.shape[-1]
+    z = xz_ref[0].astype(jnp.float32) + jax.lax.dot_general(
+        h_prev, rw_ref[...].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    zi, zf, zo, zg = (z[:, :n], z[:, n:2 * n], z[:, 2 * n:3 * n],
+                      z[:, 3 * n:])
+    i = jax.nn.sigmoid(zi + c_prev * pi_ref[0])
+    f = jax.nn.sigmoid(zf + c_prev * pf_ref[0])
+    g = jnp.tanh(zg)
+    c = f * c_prev + i * g
+    o = jax.nn.sigmoid(zo + c * po_ref[0])
+    h = o * jnp.tanh(c)
+    h_s[...] = h
+    c_s[...] = c
+    if save_residuals:
+        zs_ref[0] = z
+        cs_ref[0] = c
+    hs_ref[0] = h.astype(hs_ref.dtype)
+
+
+def _forward(xz, rw, pi, pf, po, interpret, save_residuals):
+    """xz [T,B,4H] time-major -> hs [T,B,H] (xz.dtype), plus (cs, zs)
+    f32 residuals for the backward when save_residuals.  The inference
+    primal uses save_residuals=False: hs is the ONLY HBM write."""
+    t, b, four_n = xz.shape
+    n = four_n // 4
+    step_spec = pl.BlockSpec((1, b, n), lambda i: (i, 0, 0))
+    out_specs = [step_spec]
+    out_shape = [jax.ShapeDtypeStruct((t, b, n), xz.dtype)]
+    if save_residuals:
+        out_specs += [step_spec,
+                      pl.BlockSpec((1, b, four_n), lambda i: (i, 0, 0))]
+        out_shape += [jax.ShapeDtypeStruct((t, b, n), jnp.float32),
+                      jax.ShapeDtypeStruct((t, b, four_n), jnp.float32)]
+    out = pl.pallas_call(
+        functools.partial(_lstm_kernel, save_residuals),
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, four_n), lambda i: (i, 0, 0)),   # xz step
+            pl.BlockSpec((n, four_n), lambda i: (0, 0)),         # rw
+            pl.BlockSpec((1, n), lambda i: (0, 0)),              # pi
+            pl.BlockSpec((1, n), lambda i: (0, 0)),              # pf
+            pl.BlockSpec((1, n), lambda i: (0, 0)),              # po
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((b, n), jnp.float32),
+                        pltpu.VMEM((b, n), jnp.float32)],
+        interpret=interpret,
+    )(xz, rw, pi.reshape(1, n), pf.reshape(1, n), po.reshape(1, n))
+    return out if save_residuals else (out[0], None, None)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def fused_lstm_scan(xz, rw, pi, pf, po, interpret: bool | None = None):
+    """Fused LSTM over time.  xz [T,B,4H] = input projection + bias
+    (time-major); rw [H,4H]; pi/pf/po [H] peepholes (zeros = vanilla
+    LSTM).  Returns hs [T,B,H].  Gate order [i,f,o,g], cell act tanh —
+    matching `recurrent._lstm_apply`."""
+    hs, _, _ = _forward(xz, rw, pi, pf, po, _resolve_interpret(interpret),
+                        save_residuals=False)
+    return hs
+
+
+def _fwd(xz, rw, pi, pf, po, interpret):
+    hs, cs, zs = _forward(xz, rw, pi, pf, po, _resolve_interpret(interpret),
+                          save_residuals=True)
+    return hs, (hs, cs, zs, rw, pi, pf, po)
+
+
+def _bwd(interpret, res, dhs):
+    """Reverse-time BPTT over the kernel's saved activations (the caches
+    the reference keeps as ifogZs/ifogAs).  Runs as a plain lax.scan —
+    gradients, unlike the forward, are only needed in training where the
+    surrounding step is jit-compiled anyway."""
+    hs, cs, zs, rw, pi, pf, po = res
+    t, b, n = hs.shape
+    f32 = jnp.float32
+    dhs = dhs.astype(f32)
+    hs_f = hs.astype(f32)
+    # previous-step states (h_{-1} = c_{-1} = 0)
+    h_prev_seq = jnp.concatenate([jnp.zeros((1, b, n), f32), hs_f[:-1]])
+    c_prev_seq = jnp.concatenate([jnp.zeros((1, b, n), f32), cs[:-1]])
+    rw_f = rw.astype(f32)
+    pi_f, pf_f, po_f = (p.astype(f32) for p in (pi, pf, po))
+
+    def step(carry, inp):
+        dh_next, dc_next, drw, dpi, dpf, dpo = carry
+        dh_t, z, c_t, c_prev, h_prev = inp
+        zi, zf, zo, zg = (z[:, :n], z[:, n:2 * n], z[:, 2 * n:3 * n],
+                          z[:, 3 * n:])
+        i = jax.nn.sigmoid(zi + c_prev * pi_f)
+        f = jax.nn.sigmoid(zf + c_prev * pf_f)
+        g = jnp.tanh(zg)
+        o = jax.nn.sigmoid(zo + c_t * po_f)
+        tc = jnp.tanh(c_t)
+        dh = dh_t + dh_next
+        do = dh * tc
+        dzo = do * o * (1 - o)
+        dc = dh * o * (1 - tc * tc) + dc_next + dzo * po_f
+        di = dc * g
+        dzi = di * i * (1 - i)
+        df = dc * c_prev
+        dzf = df * f * (1 - f)
+        dg = dc * i
+        dzg = dg * (1 - g * g)
+        dz = jnp.concatenate([dzi, dzf, dzo, dzg], axis=-1)  # [B,4H]
+        dh_prev = jax.lax.dot_general(
+            dz, rw_f, (((1,), (1,)), ((), ())),
+            preferred_element_type=f32)
+        drw = drw + jax.lax.dot_general(
+            h_prev, dz, (((0,), (0,)), ((), ())),
+            preferred_element_type=f32)
+        dpi = dpi + jnp.sum(dzi * c_prev, axis=0)
+        dpf = dpf + jnp.sum(dzf * c_prev, axis=0)
+        dpo = dpo + jnp.sum(dzo * c_t, axis=0)
+        dc_prev = dc * f + dzi * pi_f + dzf * pf_f
+        return (dh_prev, dc_prev, drw, dpi, dpf, dpo), dz
+
+    zeros_bn = jnp.zeros((b, n), f32)
+    init = (zeros_bn, zeros_bn, jnp.zeros_like(rw_f),
+            jnp.zeros((n,), f32), jnp.zeros((n,), f32),
+            jnp.zeros((n,), f32))
+    (_, _, drw, dpi, dpf, dpo), dzs = lax.scan(
+        step, init, (dhs, zs, cs, c_prev_seq, h_prev_seq), reverse=True)
+    return (dzs.astype(hs.dtype), drw.astype(rw.dtype),
+            dpi.astype(pi.dtype), dpf.astype(pf.dtype),
+            dpo.astype(po.dtype))
+
+
+fused_lstm_scan.defvjp(_fwd, _bwd)

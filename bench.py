@@ -40,8 +40,10 @@ ONLY = [s for s in os.environ.get("BENCH_ONLY", "").split(",") if s]
 RETRIES = int(os.environ.get("BENCH_RETRIES", 3))
 BACKOFF = float(os.environ.get("BENCH_BACKOFF", 20))
 # TPU backend init can HANG (not just error) when the chip is unreachable;
-# bound each attempt so the harness always emits its JSON line.
-ATTEMPT_TIMEOUT = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 420))
+# bound each attempt so the harness always emits its JSON line.  600s
+# accommodates first-compile over the axon tunnel's slow relay (each
+# sub-bench compiles fresh XLA programs) while still leaving retry room.
+ATTEMPT_TIMEOUT = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 600))
 RECORD_METRIC = "LeNet-MNIST train examples/sec/chip"
 
 
@@ -310,7 +312,7 @@ def bench_transformer() -> dict:
     from deeplearning4j_tpu.parallel.hybrid import _sgd_tree
 
     on_tpu = jax.default_backend() == "tpu"
-    B, S = (8, 512) if on_tpu else (2, 64)
+    B, S = (16, 512) if on_tpu else (2, 64)
     cfg = tfm.TransformerConfig(
         vocab_size=4096, d_model=512 if on_tpu else 64,
         n_heads=8 if on_tpu else 4, n_layers=6 if on_tpu else 2,
@@ -341,7 +343,9 @@ def bench_transformer() -> dict:
     flops = (6 * B * S * n_params
              + 12 * cfg.n_layers * B * S * S * cfg.d_model)
     peak = _peak_flops(on_tpu)
-    return {"metric": "TransformerLM train tokens/sec/chip",
+    # Workload shape is part of the metric name: changing B/S re-pins the
+    # baseline instead of silently comparing different workloads.
+    return {"metric": f"TransformerLM train tokens/sec/chip (B{B}xS{S})",
             "unit": "tokens/sec", "value": round(B * S / sec, 1),
             "mfu": round(flops / sec / peak, 4), "params": n_params,
             "batch": B, "seq_len": S, "dtype": cfg.dtype}

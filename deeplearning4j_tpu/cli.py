@@ -18,6 +18,8 @@ Usage:
         -model model.json -output out/ [-conf train.props]
     python -m deeplearning4j_tpu.cli test  -input iris.svmlight -model out/model
     python -m deeplearning4j_tpu.cli predict -input iris.svmlight -model out/model -output preds.txt
+    python -m deeplearning4j_tpu.cli lm -input corpus.txt -output lm/ \
+        -generate "prompt"     # flagship TransformerLM on raw text
 """
 
 from __future__ import annotations
@@ -163,6 +165,94 @@ def cmd_train(args) -> int:
     return 0
 
 
+def cmd_lm(args) -> int:
+    """Train the flagship TransformerLM on a raw text file (byte-level
+    vocab, causal LM) and/or generate from a saved one — the CLI surface
+    for the long-context/flagship model family (no reference analog; the
+    2015 CLI stops at MultiLayerNetwork training, Train.java:64)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel import transformer as tfm
+    from deeplearning4j_tpu.parallel.generation import generate
+    from deeplearning4j_tpu.runtime.checkpoint import (
+        npz_to_tree,
+        tree_to_npz,
+    )
+
+    out = pathlib.Path(args.output or "dl4j-lm")
+    cfg_path, params_path = out / "lm_config.json", out / "lm_params.npz"
+
+    def save(cfg, params):
+        out.mkdir(parents=True, exist_ok=True)
+        cfg_path.write_text(json.dumps(cfg.__dict__))
+        tree_to_npz(params_path, params)  # atomic write
+
+    def load():
+        if not params_path.exists():
+            raise SystemExit(f"saved LM incomplete: {params_path} missing")
+        cfg = tfm.TransformerConfig(**json.loads(cfg_path.read_text()))
+        params = npz_to_tree(params_path,
+                             tfm.init_params(cfg, jax.random.PRNGKey(0)))
+        return cfg, jax.tree_util.tree_map(jnp.asarray, params)
+
+    if args.input:
+        text = pathlib.Path(args.input).read_bytes()
+        ids = np.frombuffer(text, np.uint8).astype(np.int32)
+        S, B = args.seq, args.batch
+        if len(ids) < S + 2:
+            raise SystemExit(f"input too short for -seq {S}")
+        cfg = tfm.TransformerConfig(
+            vocab_size=256, d_model=args.d_model, n_heads=args.heads,
+            n_layers=args.layers, d_ff=4 * args.d_model, max_len=S)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+
+        @jax.jit
+        def step(p, tokens, targets):
+            loss, grads = jax.value_and_grad(
+                lambda q: tfm.lm_loss(cfg, q, tokens, targets))(p)
+            return jax.tree_util.tree_map(
+                lambda w, g: w - args.lr * g, p, grads), loss
+
+        rng = np.random.default_rng(0)
+        steps = max(1, args.epochs * (len(ids) // max(B * S, 1)))
+        t0, loss = time.time(), None
+        for k in range(steps):
+            starts = rng.integers(0, len(ids) - S - 1, B)
+            tokens = jnp.asarray(np.stack([ids[s:s + S] for s in starts]))
+            targets = jnp.asarray(
+                np.stack([ids[s + 1:s + S + 1] for s in starts]))
+            params, loss = step(params, tokens, targets)
+            if args.verbose and (k + 1) % 20 == 0:
+                print(f"step {k + 1}/{steps} loss {float(loss):.4f}")
+        tok_rate = steps * B * S / max(time.time() - t0, 1e-9)
+        print(f"Trained {steps} steps (final loss {float(loss):.4f}, "
+              f"{tok_rate:.0f} tokens/sec)")
+        save(cfg, params)
+        print(f"LM saved to {out}")
+    else:
+        if not cfg_path.exists():
+            raise SystemExit(f"no -input and no saved LM at {out}")
+        cfg, params = load()
+
+    if args.generate is not None:
+        prompt = np.frombuffer(
+            (args.generate or "\n").encode(), np.uint8).astype(np.int32)
+        if len(prompt) + args.max_new > cfg.max_len:
+            raise SystemExit(
+                f"prompt ({len(prompt)} bytes) + -max-new ({args.max_new}) "
+                f"exceeds the model's context ({cfg.max_len}, set by -seq "
+                f"at training time) — shorten one of them")
+        toks = generate(cfg, params, prompt[None, :],
+                        max_new_tokens=args.max_new,
+                        temperature=args.temperature,
+                        rng=jax.random.PRNGKey(args.gen_seed))
+        text = bytes(np.asarray(toks[0], np.uint8)).decode(
+            errors="replace")
+        print(text)
+    return 0
+
+
 def cmd_test(args) -> int:
     props = load_properties(args.conf)
     ds = _load_dataset(args.input, props)
@@ -214,6 +304,32 @@ def build_parser() -> argparse.ArgumentParser:
     p_train.add_argument("-epochs", "--epochs", type=int, default=50)
     p_train.add_argument("-batch", "--batch", type=int, default=32)
     p_train.set_defaults(fn=cmd_train)
+
+    p_lm = sub.add_parser(
+        "lm", help="train/sample the TransformerLM on raw text")
+    p_lm.add_argument("-input", "--input", default=None,
+                      help="raw text file (omit to generate from a saved LM)")
+    p_lm.add_argument("-output", "--output", default=None,
+                      help="save/load directory (default dl4j-lm)")
+    p_lm.add_argument("-epochs", "--epochs", type=int, default=1)
+    p_lm.add_argument("-batch", "--batch", type=int, default=8)
+    p_lm.add_argument("-seq", "--seq", type=int, default=128)
+    p_lm.add_argument("-d-model", "--d-model", dest="d_model", type=int,
+                      default=128)
+    p_lm.add_argument("-layers", "--layers", type=int, default=2)
+    p_lm.add_argument("-heads", "--heads", type=int, default=4)
+    p_lm.add_argument("-lr", "--lr", type=float, default=3e-3)
+    p_lm.add_argument("-generate", "--generate", nargs="?", const="",
+                      default=None, metavar="PROMPT",
+                      help="sample after training/loading (optional prompt)")
+    p_lm.add_argument("-max-new", "--max-new", dest="max_new", type=int,
+                      default=64)
+    p_lm.add_argument("-temperature", "--temperature", type=float,
+                      default=0.8)
+    p_lm.add_argument("-gen-seed", "--gen-seed", dest="gen_seed", type=int,
+                      default=0)
+    p_lm.add_argument("-verbose", "--verbose", action="store_true")
+    p_lm.set_defaults(fn=cmd_lm)
 
     p_test = sub.add_parser("test", help="evaluate a saved model")
     common(p_test)

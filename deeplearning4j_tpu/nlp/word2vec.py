@@ -318,30 +318,40 @@ class Word2Vec(WordVectors):
             if total_pairs is None:
                 total_pairs = max(len(pairs) * self.epochs, 1)
             B = self.batch_size
-            # Pad the tail batch to keep ONE compiled step (static shapes).
-            n_full = (len(pairs) + B - 1) // B
-            for bi in range(n_full):
-                chunk = pairs[bi * B:(bi + 1) * B]
-                n_real = len(chunk)
-                valid = np.ones(B, np.int32)
-                if n_real < B:
-                    # Pad the tail to the compiled shape; the valid mask
-                    # zeroes the fake rows' loss so no spurious updates.
-                    valid[n_real:] = 0
-                    pad = np.zeros((B - n_real, 2), np.int32)
-                    chunk = np.concatenate([chunk, pad])
-                # Linear LR decay by pairs seen (reference `alpha` decay,
-                # Word2Vec.java:231-238), floored at min_learning_rate.
-                frac = min(seen / total_pairs, 1.0)
-                lr = max(self.learning_rate * (1 - frac),
-                         self.min_learning_rate)
-                key, sub = jax.random.split(key)
-                syn0, out, _ = step(syn0, out,
-                                    jnp.asarray(chunk[:, 0]),
-                                    jnp.asarray(chunk[:, 1]),
-                                    jnp.float32(lr), sub,
-                                    jnp.asarray(valid))
-                seen += n_real
+            # Stage the pair stream on device in BOUNDED chunks (~1M
+            # pairs each): per-batch slicing inside a chunk is
+            # device-side — no host->device transfer in the hot loop
+            # (HBM/tunnel hygiene) — while memory stays O(chunk), not
+            # O(corpus).  The valid mask is all-ones except the final
+            # tail batch, so only two [B] masks ever exist.
+            n_batches = (len(pairs) + B - 1) // B  # 0 -> epoch skipped
+            chunk_batches = max(1, 1_048_576 // B)
+            full_valid = jnp.ones((B,), jnp.int32)
+            for c0 in range(0, n_batches, chunk_batches):
+                c1 = min(c0 + chunk_batches, n_batches)
+                part = pairs[c0 * B:c1 * B]
+                padded = np.zeros(((c1 - c0) * B, 2), np.int32)
+                padded[:len(part)] = part
+                chunk_dev = jnp.asarray(padded.reshape(c1 - c0, B, 2))
+                for bi in range(c1 - c0):
+                    n_real = min(B, len(pairs) - (c0 + bi) * B)
+                    if n_real < B:
+                        tail = np.zeros((B,), np.int32)
+                        tail[:n_real] = 1
+                        valid = jnp.asarray(tail)
+                    else:
+                        valid = full_valid
+                    # Linear LR decay by pairs seen (reference `alpha`
+                    # decay, Word2Vec.java:231-238), floored at
+                    # min_learning_rate.
+                    frac = min(seen / total_pairs, 1.0)
+                    lr = max(self.learning_rate * (1 - frac),
+                             self.min_learning_rate)
+                    key, sub = jax.random.split(key)
+                    syn0, out, _ = step(
+                        syn0, out, chunk_dev[bi, :, 0], chunk_dev[bi, :, 1],
+                        jnp.float32(lr), sub, valid)
+                    seen += n_real
         self.syn0 = np.asarray(syn0)
         if use_hs:
             self.syn1 = np.asarray(out)

@@ -117,3 +117,23 @@ def test_csv_input(model_json, tmp_path, capsys):
     rc = main(["train", "-input", str(csv), "-model", str(model_json),
                "-output", str(tmp_path / "o"), "-epochs", "2"])
     assert rc == 0
+
+
+def test_lm_train_save_generate(tmp_path, capsys):
+    """`dl4j lm`: byte-level TransformerLM trains on raw text, saves, and
+    a second invocation generates from the saved model."""
+    text = tmp_path / "corpus.txt"
+    text.write_text("the quick brown fox jumps over the lazy dog. " * 40)
+    out = tmp_path / "lm"
+    rc = main(["lm", "-input", str(text), "-output", str(out),
+               "-epochs", "2", "-batch", "4", "-seq", "32",
+               "-d-model", "32", "-layers", "1", "-heads", "2"])
+    assert rc == 0
+    assert (out / "lm_config.json").exists()
+    assert (out / "lm_params.npz").exists()
+    assert "tokens/sec" in capsys.readouterr().out
+    rc = main(["lm", "-output", str(out), "-generate", "the quick",
+               "-max-new", "8", "-temperature", "0"])
+    assert rc == 0
+    sampled = capsys.readouterr().out
+    assert sampled.startswith("the quick") and len(sampled) > len("the quick")

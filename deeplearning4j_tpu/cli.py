@@ -246,6 +246,7 @@ def cmd_lm(args) -> int:
         toks = generate(cfg, params, prompt[None, :],
                         max_new_tokens=args.max_new,
                         temperature=args.temperature,
+                        top_k=args.top_k, top_p=args.top_p,
                         rng=jax.random.PRNGKey(args.gen_seed))
         text = bytes(np.asarray(toks[0], np.uint8)).decode(
             errors="replace")
@@ -326,6 +327,10 @@ def build_parser() -> argparse.ArgumentParser:
                       default=64)
     p_lm.add_argument("-temperature", "--temperature", type=float,
                       default=0.8)
+    p_lm.add_argument("-top-k", "--top-k", dest="top_k", type=int,
+                      default=0, help="truncate sampling to k best tokens")
+    p_lm.add_argument("-top-p", "--top-p", dest="top_p", type=float,
+                      default=1.0, help="nucleus sampling mass")
     p_lm.add_argument("-gen-seed", "--gen-seed", dest="gen_seed", type=int,
                       default=0)
     p_lm.add_argument("-verbose", "--verbose", action="store_true")

@@ -21,6 +21,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from deeplearning4j_tpu.parallel.mesh import (
+    data_parallel_grads,
+    round_batch_to_mesh,
+)
 
 from deeplearning4j_tpu.nlp.tokenization import (
     DefaultTokenizerFactory,
@@ -89,11 +93,15 @@ class Glove(WordVectors):
                  batch_size: int = 4096,
                  epochs: int = 25,
                  seed: int = 42,
-                 tokenizer_factory: Optional[TokenizerFactory] = None):
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 mesh=None):
         self.window = window
         self.learning_rate = learning_rate
         self.x_max = x_max
         self.alpha = alpha
+        self.mesh = mesh  # jax Mesh: shard COO batches over its 1st axis
+        if mesh is not None:
+            batch_size = round_batch_to_mesh(batch_size, mesh)
         self.batch_size = batch_size
         self.epochs = epochs
         self.seed = seed
@@ -106,8 +114,7 @@ class Glove(WordVectors):
         x_max, alpha = self.x_max, self.alpha
         lr = self.learning_rate
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, adagrad, ii, jj, xx, valid):
+        def local_grads(params, ii, jj, xx, valid):
             def loss_fn(p):
                 w, wc, b, bc = p
                 diff = (jnp.sum(w[ii] * wc[jj], axis=1) + b[ii] + bc[jj]
@@ -117,7 +124,22 @@ class Glove(WordVectors):
                 # so duplicated tail pairs contribute no gradient.
                 return 0.5 * jnp.sum(valid * fx * diff * diff)
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+            return jax.value_and_grad(loss_fn)(params)
+
+        if self.mesh is not None:
+            # Mesh-parallel (same design as Word2Vec mesh=): COO batch
+            # sharded over the data axis, params replicated, grads+loss
+            # psum'd over ICI — every replica applies one identical
+            # AdaGrad update (the TPU-native distributed GloVe, replacing
+            # the reference's Spark driver-fold, spark Glove.java:241).
+            grads_fn = data_parallel_grads(local_grads, self.mesh,
+                                           n_replicated=1, n_sharded=4)
+        else:
+            grads_fn = local_grads
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, adagrad, ii, jj, xx, valid):
+            loss, grads = grads_fn(params, ii, jj, xx, valid)
             # Per-element AdaGrad (reference GloveWeightLookupTable).
             new_params, new_ada = [], []
             for p, g, h in zip(params, grads, adagrad):

@@ -38,22 +38,11 @@ from typing import Iterable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
-from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map as _shard_map
-
-    def _smap(f, *, mesh, in_specs, out_specs):
-        # jax>=0.8 renamed check_rep -> check_vma
-        return _shard_map(f, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, check_vma=False)
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map as _exp_shard_map
-
-    def _smap(f, *, mesh, in_specs, out_specs):
-        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_rep=False)
+from deeplearning4j_tpu.parallel.mesh import (
+    data_parallel_grads,
+    round_batch_to_mesh,
+)
 
 from deeplearning4j_tpu.nlp.tokenization import (
     DefaultTokenizerFactory,
@@ -99,8 +88,7 @@ class Word2Vec(WordVectors):
         self.subsample = subsample
         self.mesh = mesh  # jax.sharding.Mesh: shard pairs over its 1st axis
         if mesh is not None:
-            n = mesh.devices.size
-            batch_size = ((batch_size + n - 1) // n) * n  # divisible shards
+            batch_size = round_batch_to_mesh(batch_size, mesh)
         self.batch_size = batch_size
         self.epochs = epochs
         self.seed = seed
@@ -273,25 +261,8 @@ class Word2Vec(WordVectors):
         numerics (a one-shard psum)."""
         if self.mesh is None:
             return grads_fn
-        mesh, axis = self.mesh, self.mesh.axis_names[0]
-
-        if with_key:
-            def local(s0, s1, inputs, targets, valid, key):
-                key = jax.random.fold_in(key, lax.axis_index(axis))
-                loss, g0, g1 = grads_fn(s0, s1, inputs, targets, valid, key)
-                return (lax.psum(loss, axis), lax.psum(g0, axis),
-                        lax.psum(g1, axis))
-
-            in_specs = (P(), P(), P(axis), P(axis), P(axis), P())
-        else:
-            def local(s0, s1, inputs, targets, valid):
-                loss, g0, g1 = grads_fn(s0, s1, inputs, targets, valid)
-                return (lax.psum(loss, axis), lax.psum(g0, axis),
-                        lax.psum(g1, axis))
-
-            in_specs = (P(), P(), P(axis), P(axis), P(axis))
-        return _smap(local, mesh=mesh, in_specs=in_specs,
-                     out_specs=(P(), P(), P()))
+        return data_parallel_grads(grads_fn, self.mesh, n_replicated=2,
+                                   n_sharded=3, with_key=with_key)
 
     # ------------------------------------------------------------------
     # fit (reference Word2Vec.fit():103)

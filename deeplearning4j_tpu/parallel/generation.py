@@ -84,9 +84,27 @@ def decode_step(cfg: TransformerConfig, params: dict, cache: dict,
     return logits, new_cache
 
 
+def _filter_top_k(logits, top_k: int):
+    """Keep the top_k largest logits per row; mask the rest."""
+    kth = lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits < kth, -1e30, logits)
+
+
+def _filter_top_p(logits, top_p):
+    """Nucleus filtering: keep the smallest set of tokens whose
+    cumulative probability reaches top_p (the argmax always survives)."""
+    sorted_l = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    # token kept iff the mass BEFORE it is still below top_p
+    keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+    cutoff = jnp.min(jnp.where(keep, sorted_l, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits < cutoff, -1e30, logits)
+
+
 @functools.lru_cache(maxsize=32)
 def _compiled_run(cfg: TransformerConfig, batch: int, max_new_tokens: int,
-                  sampled: bool):
+                  sampled: bool, top_k: int, top_p: float):
     """One jitted program per (config, batch, length, mode) — stable across
     generate() calls so repeated generation never retraces."""
 
@@ -102,9 +120,14 @@ def _compiled_run(cfg: TransformerConfig, batch: int, max_new_tokens: int,
         last = logits[-1]                                 # [B, V]
 
         def pick(logits, key):
-            if sampled:
-                return jax.random.categorical(key, logits / temperature)
-            return jnp.argmax(logits, axis=-1)
+            if not sampled:
+                return jnp.argmax(logits, axis=-1)
+            logits = logits.astype(jnp.float32) / temperature
+            if 0 < top_k < logits.shape[-1]:
+                logits = _filter_top_k(logits, top_k)
+            if top_p < 1.0:
+                logits = _filter_top_p(logits, top_p)
+            return jax.random.categorical(key, logits)
 
         def step(carry, key):
             cache, last_logits = carry
@@ -121,12 +144,14 @@ def _compiled_run(cfg: TransformerConfig, batch: int, max_new_tokens: int,
 
 def generate(cfg: TransformerConfig, params: dict, prompt,
              max_new_tokens: int, temperature: float = 0.0,
-             rng: Optional[jax.Array] = None) -> jax.Array:
+             rng: Optional[jax.Array] = None, top_k: int = 0,
+             top_p: float = 1.0) -> jax.Array:
     """prompt: [B, P] int -> [B, P + max_new_tokens] int32.
 
-    temperature 0 = greedy; otherwise softmax sampling (rng required).
-    The prefill and every decode step run inside ONE jitted lax.scan,
-    compiled once per (config, batch, length, mode).
+    temperature 0 = greedy; otherwise softmax sampling (rng required),
+    optionally truncated to the top_k most likely tokens and/or the
+    top_p nucleus.  The prefill and every decode step run inside ONE
+    jitted lax.scan, compiled once per (config, batch, length, mode).
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     batch, plen = prompt.shape
@@ -138,9 +163,18 @@ def generate(cfg: TransformerConfig, params: dict, prompt,
                          f"max_len({cfg.max_len})")
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature>0) requires rng")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    run = _compiled_run(cfg, batch, max_new_tokens, temperature > 0)
+    sampled = temperature > 0
+    # Greedy never reads top_k/top_p — normalize them out of the cache
+    # key so varying them cannot retrace or churn identical programs.
+    run = _compiled_run(cfg, batch, max_new_tokens, sampled,
+                        int(top_k) if sampled else 0,
+                        float(top_p) if sampled else 1.0)
     new = run(params, prompt, rng,
               jnp.asarray(max(temperature, 1e-6), jnp.float32))
     return jnp.concatenate([prompt, new], axis=1)

@@ -57,3 +57,53 @@ def shard_batch(mesh: Mesh, tree, axis: str = "data"):
 def replicate(mesh: Mesh, tree):
     sh = replicated(mesh)
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), tree)
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs):
+    """`jax.shard_map` across jax versions (the keyword for disabling
+    replication checking was renamed check_rep -> check_vma in jax 0.8);
+    single shim shared by every shard_map user in the package."""
+    try:
+        from jax import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+
+def round_batch_to_mesh(batch_size: int, mesh: Mesh) -> int:
+    """Smallest batch >= batch_size divisible into equal shards over the
+    mesh's devices."""
+    n = mesh.devices.size
+    return ((batch_size + n - 1) // n) * n
+
+
+def data_parallel_grads(fn, mesh: Mesh, n_replicated: int, n_sharded: int,
+                        with_key: bool = False):
+    """Shared data-parallel gradient wrapper (Word2Vec/GloVe mesh=):
+    wraps ``fn(*replicated, *sharded[, key]) -> pytree`` in shard_map —
+    leading args replicated, trailing args sharded over the mesh's FIRST
+    axis, every output leaf psum'd — so each replica holds identical
+    results and applies one identical update.  with_key folds the axis
+    index into a trailing PRNG key (per-shard randomness, e.g. negative
+    sampling)."""
+    axis = mesh.axis_names[0]
+
+    def local(*args):
+        if with_key:
+            *rest, key = args
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+            out = fn(*rest, key)
+        else:
+            out = fn(*args)
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a, axis), out)
+
+    in_specs = ((P(),) * n_replicated + (P(axis),) * n_sharded
+                + ((P(),) if with_key else ()))
+    return shard_map_compat(local, mesh=mesh, in_specs=in_specs,
+                            out_specs=P())

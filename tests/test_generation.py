@@ -88,3 +88,34 @@ def test_gpt2_cached_generation_matches_hf():
                               do_sample=False,
                               pad_token_id=0)[0].tolist()
     assert ours == want
+
+
+def test_top_k_one_and_tiny_top_p_equal_greedy():
+    """top_k=1 (or a nucleus so small only the argmax survives) collapses
+    sampling to the greedy path regardless of temperature/seed."""
+    cfg = _cfg()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(4))
+    prompt = np.asarray([[3, 1, 4]], np.int32)
+    greedy = np.asarray(generate(cfg, params, prompt, 6))
+    k1 = np.asarray(generate(cfg, params, prompt, 6, temperature=5.0,
+                             rng=jax.random.PRNGKey(9), top_k=1))
+    p_tiny = np.asarray(generate(cfg, params, prompt, 6, temperature=5.0,
+                                 rng=jax.random.PRNGKey(9), top_p=1e-6))
+    np.testing.assert_array_equal(greedy, k1)
+    np.testing.assert_array_equal(greedy, p_tiny)
+
+
+def test_top_k_and_top_p_stay_in_vocab_and_validate():
+    cfg = _cfg()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(5))
+    prompt = np.asarray([[0, 2]], np.int32)
+    out = np.asarray(generate(cfg, params, prompt, 5, temperature=1.0,
+                              rng=jax.random.PRNGKey(1), top_k=3,
+                              top_p=0.9))
+    assert (out >= 0).all() and (out < cfg.vocab_size).all()
+    with pytest.raises(ValueError, match="top_k"):
+        generate(cfg, params, prompt, 2, temperature=1.0,
+                 rng=jax.random.PRNGKey(0), top_k=-1)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(cfg, params, prompt, 2, temperature=1.0,
+                 rng=jax.random.PRNGKey(0), top_p=0.0)

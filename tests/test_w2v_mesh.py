@@ -70,3 +70,24 @@ def test_mesh_batch_size_rounds_up_to_shardable():
     mesh = make_mesh((4,), ("data",), devices=jax.devices()[:4])
     w = Word2Vec(batch_size=1022, mesh=mesh)
     assert w.batch_size % 4 == 0
+
+
+class TestGloveMesh:
+    """Mesh-parallel GloVe: COO batches sharded, grads psum'd — training
+    has NO per-shard randomness, so N-device must match single-device
+    (up to float reduction order)."""
+
+    def test_glove_mesh_matches_single_device(self, corpus):
+        from deeplearning4j_tpu.nlp.glove import Glove
+
+        def train(mesh):
+            g = Glove(vector_length=16, window=4, epochs=5, batch_size=512,
+                      seed=3, mesh=mesh)
+            return g.fit(corpus[:120])
+
+        single = train(None)
+        mesh = make_mesh((4,), ("data",), devices=jax.devices()[:4])
+        sharded = train(mesh)
+        np.testing.assert_allclose(single.syn0, sharded.syn0,
+                                   atol=1e-4, rtol=1e-4)
+        assert sharded.losses[-1] < sharded.losses[0]

@@ -278,7 +278,7 @@ def bench_scaling() -> dict:
         if n_dev > 1:
             mesh = make_mesh((n_dev,), ("data",),
                              devices=jax.devices()[:n_dev])
-            fit = DataParallelTrainer(net, mesh=mesh).fit_batch
+            fit = DataParallelTrainer(net, mesh=mesh).fit_batch_async
         b = per_chip * n_dev
         x = np.asarray(rng.random((b, 32, 32, 3), dtype=np.float32))
         y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, b)]

@@ -34,15 +34,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
-try:
-    from jax import shard_map as _shard_map
+from deeplearning4j_tpu.parallel.mesh import shard_map_compat
 
-    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
-        # jax>=0.8 renamed check_rep -> check_vma
-        return _shard_map(f, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, check_vma=check_rep)
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
+    """Thin alias over the package's single jax-version shim
+    (`mesh.shard_map_compat`); kept for its importers (hybrid,
+    transformer) and the check_rep-style signature."""
+    del check_rep  # replication checking is always off (see the shim)
+    return shard_map_compat(f, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs)
 
 from deeplearning4j_tpu.models.multi_layer_network import (
     MultiLayerNetwork,

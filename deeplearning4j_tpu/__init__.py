@@ -34,6 +34,7 @@ _LAZY = {
                             "DataParallelTrainer"),
     "make_mesh": ("deeplearning4j_tpu.parallel", "make_mesh"),
     "generate": ("deeplearning4j_tpu.parallel", "generate"),
+    "beam_search": ("deeplearning4j_tpu.parallel", "beam_search"),
     "load_source": ("deeplearning4j_tpu.ml", "load_source"),
     "Evaluation": ("deeplearning4j_tpu.evaluation", "Evaluation"),
 }

@@ -1,5 +1,5 @@
 from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
-from deeplearning4j_tpu.parallel.generation import generate
+from deeplearning4j_tpu.parallel.generation import beam_search, generate
 from deeplearning4j_tpu.parallel.mesh import make_mesh
 
-__all__ = ["make_mesh", "DataParallelTrainer", "generate"]
+__all__ = ["make_mesh", "DataParallelTrainer", "generate", "beam_search"]

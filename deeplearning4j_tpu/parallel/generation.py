@@ -102,6 +102,18 @@ def _filter_top_p(logits, top_p):
     return jnp.where(logits < cutoff, -1e30, logits)
 
 
+def _prefill(cfg, params, prompt):
+    """Run the prompt through the decoder: (filled cache, last logits)."""
+    cache = init_cache(cfg, prompt.shape[0])
+
+    def body(cache, tok):
+        logits, cache = decode_step(cfg, params, cache, tok)
+        return cache, logits
+
+    cache, logits = lax.scan(body, cache, prompt.T)
+    return cache, logits[-1]                              # [B, V]
+
+
 @functools.lru_cache(maxsize=32)
 def _compiled_run(cfg: TransformerConfig, batch: int, max_new_tokens: int,
                   sampled: bool, top_k: int, top_p: float):
@@ -110,14 +122,7 @@ def _compiled_run(cfg: TransformerConfig, batch: int, max_new_tokens: int,
 
     @jax.jit
     def run(params, prompt, rng, temperature):
-        cache = init_cache(cfg, batch)
-
-        def prefill(cache, tok):
-            logits, cache = decode_step(cfg, params, cache, tok)
-            return cache, logits
-
-        cache, logits = lax.scan(prefill, cache, prompt.T)
-        last = logits[-1]                                 # [B, V]
+        cache, last = _prefill(cfg, params, prompt)
 
         def pick(logits, key):
             if not sampled:
@@ -178,3 +183,84 @@ def generate(cfg: TransformerConfig, params: dict, prompt,
     new = run(params, prompt, rng,
               jnp.asarray(max(temperature, 1e-6), jnp.float32))
     return jnp.concatenate([prompt, new], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Beam search (extension: the reference has no generative inference at all)
+
+@functools.lru_cache(maxsize=16)
+def _compiled_beam_run(cfg: TransformerConfig, batch: int, k: int,
+                       max_new_tokens: int):
+    """One jitted beam-search program per (config, batch, beams, length)."""
+
+    @jax.jit
+    def run(params, prompt):
+        # Prefill once per INPUT row, then tile the cache to the beams.
+        cache, logits = _prefill(cfg, params, prompt)
+        last = jax.nn.log_softmax(logits.astype(jnp.float32))  # [B, V]
+
+        def tile(a):  # [L, B, ...] -> [L, B*k, ...] beams contiguous per row
+            return jnp.repeat(a, k, axis=1)
+
+        cache = {"k": tile(cache["k"]), "v": tile(cache["v"]),
+                 "pos": cache["pos"]}
+        v = last.shape[-1]
+        # Seed: only beam 0 live per row, so step 1 picks k DISTINCT tokens.
+        scores = jnp.where(jnp.arange(k) == 0, 0.0, -1e30)  # [k]
+        scores = jnp.tile(scores, (batch, 1))               # [B, k]
+        logp = jnp.repeat(last, k, axis=0)                  # [B*k, V]
+        toks0 = jnp.zeros((batch * k, max_new_tokens), jnp.int32)
+
+        def step(carry, _):
+            cache, scores, logp, toks, t = carry
+            total = scores[:, :, None] + logp.reshape(batch, k, v)
+            flat = total.reshape(batch, k * v)
+            top_scores, top_idx = lax.top_k(flat, k)        # [B, k]
+            parent = top_idx // v                           # beam index
+            token = (top_idx % v).astype(jnp.int32)
+            # Gather parent beams' caches and emitted-token histories.
+            row = jnp.arange(batch)[:, None] * k + parent   # [B, k] flat idx
+            flat_row = row.reshape(-1)
+            cache = {"k": cache["k"][:, flat_row],
+                     "v": cache["v"][:, flat_row], "pos": cache["pos"]}
+            toks = toks[flat_row].at[:, t].set(token.reshape(-1))
+            logits, cache = decode_step(cfg, params, cache,
+                                        token.reshape(-1))
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            return (cache, top_scores, logp, toks, t + 1), None
+
+        (cache, scores, logp, toks, _), _ = lax.scan(
+            step, (cache, scores, logp, toks0, jnp.zeros((), jnp.int32)),
+            None, length=max_new_tokens)
+        best = jnp.argmax(scores, axis=1)                   # [B]
+        toks = toks.reshape(batch, k, max_new_tokens)
+        return toks[jnp.arange(batch), best], scores[jnp.arange(batch), best]
+
+    return run
+
+
+def beam_search(cfg: TransformerConfig, params: dict, prompt,
+                max_new_tokens: int, beam_size: int = 4):
+    """Deterministic beam-search decoding over the KV-cached decoder.
+
+    prompt [B, P] int -> (tokens [B, P + max_new_tokens] int32,
+    summed log-prob scores [B] of the winning beams).  beam_size=1
+    degenerates to greedy.  All beams decode exactly max_new_tokens
+    tokens (no EOS handling), so every candidate has equal length and a
+    GNMT-style length penalty would not change the ranking — none is
+    offered.  The whole search — prefill, per-step top-k over
+    (beam, token) pairs, parent cache gathers — runs inside ONE jitted
+    lax.scan.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    batch, plen = prompt.shape
+    if plen < 1:
+        raise ValueError("prompt must contain at least one token")
+    if plen + max_new_tokens > cfg.max_len:
+        raise ValueError(f"prompt({plen}) + new({max_new_tokens}) exceeds "
+                         f"max_len({cfg.max_len})")
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    run = _compiled_beam_run(cfg, batch, int(beam_size), max_new_tokens)
+    new, scores = run(params, prompt)
+    return jnp.concatenate([prompt, new], axis=1), scores

@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 from deeplearning4j_tpu.parallel import transformer as tfm
 from deeplearning4j_tpu.parallel.generation import (
@@ -119,3 +120,46 @@ def test_top_k_and_top_p_stay_in_vocab_and_validate():
     with pytest.raises(ValueError, match="top_p"):
         generate(cfg, params, prompt, 2, temperature=1.0,
                  rng=jax.random.PRNGKey(0), top_p=0.0)
+
+
+class TestBeamSearch:
+    def test_beam_one_equals_greedy(self):
+        from deeplearning4j_tpu.parallel.generation import beam_search
+
+        cfg = _cfg()
+        params = tfm.init_params(cfg, jax.random.PRNGKey(6))
+        prompt = np.asarray([[2, 7], [1, 3]], np.int32)
+        greedy = np.asarray(generate(cfg, params, prompt, 6))
+        beam, _ = beam_search(cfg, params, prompt, 6, beam_size=1)
+        np.testing.assert_array_equal(greedy, np.asarray(beam))
+
+    def test_winning_score_matches_teacher_forced_logprob(self):
+        """The reported score must equal the sum of per-step log-probs of
+        the returned sequence under the model (re-scored with the full
+        non-cached forward)."""
+        from deeplearning4j_tpu.parallel.generation import beam_search
+
+        cfg = _cfg()
+        params = tfm.init_params(cfg, jax.random.PRNGKey(7))
+        prompt = np.asarray([[4, 0, 9]], np.int32)
+        new = 5
+        toks4, s4 = beam_search(cfg, params, prompt, new, beam_size=4)
+        out = np.asarray(toks4)
+        assert out.shape == (1, 3 + new)
+        assert (out >= 0).all() and (out < cfg.vocab_size).all()
+        logits = np.asarray(tfm.apply(cfg, params, jnp.asarray(out)))
+        logp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
+        total = sum(float(logp[0, t - 1, out[0, t]])
+                    for t in range(prompt.shape[1], out.shape[1]))
+        assert abs(total - float(s4[0])) < 1e-3, (total, float(s4[0]))
+
+    def test_beam_validation(self):
+        from deeplearning4j_tpu.parallel.generation import beam_search
+
+        cfg = _cfg(max_len=8)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(8))
+        with pytest.raises(ValueError, match="beam_size"):
+            beam_search(cfg, params, np.zeros((1, 2), np.int32), 2,
+                        beam_size=0)
+        with pytest.raises(ValueError, match="max_len"):
+            beam_search(cfg, params, np.zeros((1, 6), np.int32), 4)

@@ -17,17 +17,19 @@ sparse per-pair saxpy updates, racy across a thread pool (Hogwild). Here:
   batched dot against the Huffman path rows (HS) and/or [B,K] negatives
   gathered from the unigram table, exact `log_sigmoid` instead of the
   1000-entry LUT, masked sum;
-- gradients reach syn0/syn1 through XLA's gather→scatter-add autodiff:
-  the update is mathematically the reference's sparse saxpy, but batched,
-  deterministic, and fused by the compiler;
+- gradients for syn0/syn1 are hand-derived for the TOUCHED rows only
+  (the reference's per-pair saxpy math, batched) and applied as
+  scatter-adds: O(B·D) work per step, never a dense O(V·D) gradient
+  table, so vocabulary size costs memory, not step time;
 - Hogwild's lock-free parallelism (`Word2Vec.java:145-258` thread pool
   over shared syn0, `InMemoryLookupTable.java:192`) maps to data-parallel
   batch sharding: pass ``mesh=`` and each step shard_maps the pair batch
-  over the mesh's data axis, psums the syn0/syn1 gradients over ICI, and
-  applies one identical update per replica — *more* synchronous than the
-  reference's racy updates, not less, and bit-stable across device counts
-  up to float reduction order.  ``mesh=None`` is the single-device case
-  with identical numerics (the psum of one shard).
+  over the mesh's data axis, all_gathers the sparse (row, delta) pairs
+  over ICI (O(B·D) comms, not a dense psum), and applies one identical
+  scatter per replica — *more* synchronous than the reference's racy
+  updates, not less, and bit-stable across device counts up to float
+  reduction order.  ``mesh=None`` is the single-device case with
+  identical numerics.
 """
 
 from __future__ import annotations
@@ -38,10 +40,11 @@ from typing import Iterable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.mesh import (
-    data_parallel_grads,
     round_batch_to_mesh,
+    shard_map_compat,
 )
 
 from deeplearning4j_tpu.nlp.tokenization import (
@@ -60,6 +63,18 @@ def _log_sigmoid(x):
     # Stable log sigmoid; replaces the reference's clipped expTable LUT
     # (InMemoryLookupTable.java:173-177, MAX_EXP=6).
     return -jax.nn.softplus(-x)
+
+
+# Reference MAX_EXP (InMemoryLookupTable.java): in the HIERARCHICAL
+# SOFTMAX loop, pairs whose dot saturates (|dot| >= 6) contribute NO
+# update — `iterateSample:214` skips them ("continue").  Besides parity,
+# this is load-bearing for stability: a batched step accumulates
+# hundreds of same-row contributions (e.g. doc labels in
+# ParagraphVectors), and without the skip a badly-placed high-norm row
+# feeds back |g|~1 updates and diverges geometrically; the skip freezes
+# saturated pairs exactly as the reference does.  (The NEG loop is
+# different — see _build_neg_step.)
+MAX_EXP = 6.0
 
 
 class Word2Vec(WordVectors):
@@ -187,82 +202,130 @@ class Word2Vec(WordVectors):
     # jitted training steps
 
     def _build_hs_step(self):
+        """Sparse-update HS step: gradients are hand-derived for the
+        TOUCHED rows only (the reference's `iterateSample:192` math,
+        batched), applied as `.at[].add` scatters — O(B·L·D) work and
+        memory instead of autodiff's dense O(V·D) gradient tables, which
+        is the difference between toy and real vocabularies on TPU."""
         points, codes, lengths = self._hs
         L = points.shape[1]
 
-        def grads(syn0, syn1, inputs, targets, valid):
-            def loss_fn(s0, s1):
-                h = s0[inputs]                   # [B, D] input vectors
-                p = points[targets]              # [B, L] inner-node path
-                c = codes[targets]               # [B, L] branch bits
-                mask = (jnp.arange(L)[None, :]
-                        < lengths[targets][:, None]).astype(h.dtype)
-                mask = mask * valid[:, None].astype(h.dtype)  # pad rows off
-                w = s1[p]                        # [B, L, D]
-                dots = jnp.einsum("bd,bld->bl", h, w)
-                # label 1 for code 0 (sign trick: s = 1 - 2*code)
-                sign = 1.0 - 2.0 * c.astype(h.dtype)
-                return -jnp.sum(_log_sigmoid(sign * dots) * mask)
+        def deltas(syn0, syn1, inputs, targets, valid):
+            """-> loss, (syn0 rows, syn0 deltas), (syn1 rows, syn1 deltas);
+            deltas are DESCENT directions already scaled by -1 (add
+            lr * delta to apply)."""
+            h = syn0[inputs]                     # [B, D] input vectors
+            p = points[targets]                  # [B, L] inner-node path
+            c = codes[targets]                   # [B, L] branch bits
+            mask = (jnp.arange(L)[None, :]
+                    < lengths[targets][:, None]).astype(h.dtype)
+            mask = mask * valid[:, None].astype(h.dtype)      # pad rows off
+            w = syn1[p]                          # [B, L, D]
+            dots = jnp.einsum("bd,bld->bl", h, w)
+            # label 1 for code 0 (sign trick: s = 1 - 2*code)
+            sign = 1.0 - 2.0 * c.astype(h.dtype)
+            loss = -jnp.sum(_log_sigmoid(sign * dots) * mask)
+            # d(-loss)/d(dots) = sign * sigmoid(-sign*dots), masked; the
+            # reference's MAX_EXP skip zeroes saturated pairs.
+            g = sign * jax.nn.sigmoid(-sign * dots) * mask    # [B, L]
+            g = jnp.where(jnp.abs(dots) < MAX_EXP, g, 0.0)
+            dh = jnp.einsum("bl,bld->bd", g, w)               # [B, D]
+            dw = jnp.einsum("bl,bd->bld", g, h)               # [B, L, D]
+            return loss, (inputs, dh), (p.reshape(-1),
+                                        dw.reshape(-1, h.shape[-1]))
 
-            loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
-                syn0, syn1)
-            return loss, g0, g1
-
-        grads = self._maybe_shard(grads, with_key=False)
+        step_core = self._sparse_step(deltas, with_key=False)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def hs_step(syn0, syn1, inputs, targets, lr, key, valid):
-            loss, g0, g1 = grads(syn0, syn1, inputs, targets, valid)
-            return syn0 - lr * g0, syn1 - lr * g1, loss
+            return step_core(syn0, syn1, inputs, targets, lr, valid)
 
         return hs_step
 
     def _build_neg_step(self):
+        """Sparse-update negative-sampling step; see _build_hs_step."""
         K = self.negative
         table = self._neg_table
         T = table.shape[0]
 
-        def grads(syn0, syn1neg, inputs, targets, valid, key):
+        def deltas(syn0, syn1neg, inputs, targets, valid, key):
             idx = jax.random.randint(key, (inputs.shape[0], K), 0, T)
             negs = table[idx]                    # [B, K]
+            h = syn0[inputs]                     # [B, D]
+            pos = syn1neg[targets]               # [B, D]
+            neg = syn1neg[negs]                  # [B, K, D]
+            pos_dot = jnp.sum(h * pos, axis=1)
+            neg_dot = jnp.einsum("bd,bkd->bk", h, neg)
+            # Collisions with the true target get masked out.
+            collide = negs == targets[:, None]
+            v = valid.astype(h.dtype)            # pad rows contribute zero
+            neg_mask = jnp.where(collide, 0.0, v[:, None])
+            loss = -(jnp.sum(_log_sigmoid(pos_dot) * v)
+                     + jnp.sum(_log_sigmoid(-neg_dot) * neg_mask))
+            # descent deltas (add lr * delta).  NOTE the asymmetry with
+            # the HS step: the reference's negative-sampling loop does
+            # NOT skip saturated pairs — it clamps the sigmoid to {0,1}
+            # (InMemoryLookupTable.java:271-276), which the exact sigmoid
+            # matches asymptotically, so no clip belongs here.
+            g_pos = jax.nn.sigmoid(-pos_dot) * v              # [B]
+            g_neg = -jax.nn.sigmoid(neg_dot) * neg_mask       # [B, K]
+            dh = (g_pos[:, None] * pos
+                  + jnp.einsum("bk,bkd->bd", g_neg, neg))     # [B, D]
+            dpos = g_pos[:, None] * h                         # [B, D]
+            dneg = jnp.einsum("bk,bd->bkd", g_neg, h)         # [B, K, D]
+            out_rows = jnp.concatenate([targets, negs.reshape(-1)])
+            out_deltas = jnp.concatenate(
+                [dpos, dneg.reshape(-1, h.shape[-1])])
+            return loss, (inputs, dh), (out_rows, out_deltas)
 
-            def loss_fn(s0, s1n):
-                h = s0[inputs]                   # [B, D]
-                pos = s1n[targets]               # [B, D]
-                neg = s1n[negs]                  # [B, K, D]
-                pos_dot = jnp.sum(h * pos, axis=1)
-                neg_dot = jnp.einsum("bd,bkd->bk", h, neg)
-                # Collisions with the true target get masked out.
-                collide = (negs == targets[:, None])
-                neg_ll = jnp.where(collide, 0.0, _log_sigmoid(-neg_dot))
-                v = valid.astype(h.dtype)        # pad rows contribute zero
-                return -(jnp.sum(_log_sigmoid(pos_dot) * v)
-                         + jnp.sum(neg_ll * v[:, None]))
-
-            loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
-                syn0, syn1neg)
-            return loss, g0, g1
-
-        grads = self._maybe_shard(grads, with_key=True)
+        step_core = self._sparse_step(deltas, with_key=True)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def neg_step(syn0, syn1neg, inputs, targets, lr, key, valid):
-            loss, g0, g1 = grads(syn0, syn1neg, inputs, targets, valid, key)
-            return syn0 - lr * g0, syn1neg - lr * g1, loss
+            return step_core(syn0, syn1neg, inputs, targets, lr, valid, key)
 
         return neg_step
 
-    def _maybe_shard(self, grads_fn, with_key: bool):
-        """Mesh-parallel training step core (the documented TPU-native
-        Hogwild, `Word2Vec.java:145-258`): shard the pair batch over the
-        mesh's first axis, keep syn0/syn1 replicated, psum gradients and
-        loss over ICI so every replica applies one identical update.
-        mesh=None returns the fn unwrapped — the exact single-device
-        numerics (a one-shard psum)."""
-        if self.mesh is None:
-            return grads_fn
-        return data_parallel_grads(grads_fn, self.mesh, n_replicated=2,
-                                   n_sharded=3, with_key=with_key)
+    def _sparse_step(self, deltas_fn, with_key: bool):
+        """Turn a sparse-delta fn into the full table-update step.
+
+        Single device: scatter-add `lr * delta` into the touched rows.
+        Mesh: shard the pair batch over the mesh's first axis inside
+        shard_map (the documented TPU-native Hogwild,
+        `Word2Vec.java:145-258`), `all_gather` every shard's (rows,
+        deltas) — O(B·D) over ICI instead of a dense O(V·D) psum — and
+        every replica applies the identical full scatter, so the
+        replicated tables never diverge."""
+        mesh = self.mesh
+
+        def apply(syn0, syn1, inputs, targets, lr, valid, *key):
+            loss, (r0, d0), (r1, d1) = deltas_fn(
+                syn0, syn1, inputs, targets, valid, *key)
+            syn0 = syn0.at[r0].add(lr * d0)
+            syn1 = syn1.at[r1].add(lr * d1)
+            return syn0, syn1, loss
+
+        if mesh is None:
+            return apply
+        axis = mesh.axis_names[0]
+
+        def sharded(syn0, syn1, inputs, targets, lr, valid, *key):
+            if key:
+                key = (jax.random.fold_in(
+                    key[0], jax.lax.axis_index(axis)),)
+            loss, (r0, d0), (r1, d1) = deltas_fn(
+                syn0, syn1, inputs, targets, valid, *key)
+            loss = jax.lax.psum(loss, axis)
+            r0, d0, r1, d1 = (jax.lax.all_gather(a, axis, tiled=True)
+                              for a in (r0, d0, r1, d1))
+            syn0 = syn0.at[r0].add(lr * d0)
+            syn1 = syn1.at[r1].add(lr * d1)
+            return syn0, syn1, loss
+
+        in_specs = (P(), P(), P(axis), P(axis), P(), P(axis)) + (
+            (P(),) if with_key else ())
+        return shard_map_compat(sharded, mesh=mesh, in_specs=in_specs,
+                                out_specs=(P(), P(), P()))
 
     # ------------------------------------------------------------------
     # fit (reference Word2Vec.fit():103)

@@ -147,6 +147,19 @@ def _compiled_run(cfg: TransformerConfig, batch: int, max_new_tokens: int,
     return run
 
 
+def _validate_prompt(cfg, prompt, max_new_tokens):
+    """Shared generate()/beam_search() prompt checks -> [B, P] int32."""
+    prompt = jnp.asarray(prompt, jnp.int32)
+    _, plen = prompt.shape
+    if plen < 1:
+        raise ValueError("prompt must contain at least one token "
+                         "(the first sampled token conditions on it)")
+    if plen + max_new_tokens > cfg.max_len:
+        raise ValueError(f"prompt({plen}) + new({max_new_tokens}) exceeds "
+                         f"max_len({cfg.max_len})")
+    return prompt
+
+
 def generate(cfg: TransformerConfig, params: dict, prompt,
              max_new_tokens: int, temperature: float = 0.0,
              rng: Optional[jax.Array] = None, top_k: int = 0,
@@ -158,14 +171,8 @@ def generate(cfg: TransformerConfig, params: dict, prompt,
     top_p nucleus.  The prefill and every decode step run inside ONE
     jitted lax.scan, compiled once per (config, batch, length, mode).
     """
-    prompt = jnp.asarray(prompt, jnp.int32)
-    batch, plen = prompt.shape
-    if plen < 1:
-        raise ValueError("prompt must contain at least one token "
-                         "(the first sampled token conditions on it)")
-    if plen + max_new_tokens > cfg.max_len:
-        raise ValueError(f"prompt({plen}) + new({max_new_tokens}) exceeds "
-                         f"max_len({cfg.max_len})")
+    prompt = _validate_prompt(cfg, prompt, max_new_tokens)
+    batch = prompt.shape[0]
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature>0) requires rng")
     if top_k < 0:
@@ -252,15 +259,10 @@ def beam_search(cfg: TransformerConfig, params: dict, prompt,
     (beam, token) pairs, parent cache gathers — runs inside ONE jitted
     lax.scan.
     """
-    prompt = jnp.asarray(prompt, jnp.int32)
-    batch, plen = prompt.shape
-    if plen < 1:
-        raise ValueError("prompt must contain at least one token")
-    if plen + max_new_tokens > cfg.max_len:
-        raise ValueError(f"prompt({plen}) + new({max_new_tokens}) exceeds "
-                         f"max_len({cfg.max_len})")
+    prompt = _validate_prompt(cfg, prompt, max_new_tokens)
     if beam_size < 1:
         raise ValueError(f"beam_size must be >= 1, got {beam_size}")
-    run = _compiled_beam_run(cfg, batch, int(beam_size), max_new_tokens)
+    run = _compiled_beam_run(cfg, prompt.shape[0], int(beam_size),
+                             max_new_tokens)
     new, scores = run(params, prompt)
     return jnp.concatenate([prompt, new], axis=1), scores

@@ -76,6 +76,11 @@ def _log_sigmoid(x):
 # different — see _build_neg_step.)
 MAX_EXP = 6.0
 
+# Pairs staged on device per chunk during fit() (see the fit loop): the
+# bound keeps device memory O(chunk) on huge corpora while still moving
+# data to the device outside the hot loop.
+STAGE_PAIRS = 1_048_576
+
 
 class Word2Vec(WordVectors):
     """Skip-gram word embeddings (reference Word2Vec.java defaults:
@@ -359,7 +364,7 @@ class Word2Vec(WordVectors):
             # O(corpus).  The valid mask is all-ones except the final
             # tail batch, so only two [B] masks ever exist.
             n_batches = (len(pairs) + B - 1) // B  # 0 -> epoch skipped
-            chunk_batches = max(1, 1_048_576 // B)
+            chunk_batches = max(1, STAGE_PAIRS // B)
             full_valid = jnp.ones((B,), jnp.int32)
             for c0 in range(0, n_batches, chunk_batches):
                 c1 = min(c0 + chunk_batches, n_batches)

@@ -120,3 +120,24 @@ def test_max_exp_clip_prevents_divergence():
     assert np.isfinite(np.asarray(syn0)).all()
     assert np.isfinite(float(loss))
     assert float(jnp.linalg.norm(syn0, axis=1).max()) < 100.0
+
+
+def test_multi_chunk_staging_matches_single_chunk(monkeypatch):
+    """fit() stages pairs in bounded device chunks; forcing a tiny chunk
+    size (many chunks per epoch) must reproduce the single-chunk weights
+    exactly — chunk boundaries are an implementation detail."""
+    from deeplearning4j_tpu.nlp import word2vec as w2v_mod
+
+    rng = np.random.default_rng(4)
+    vocab = [f"w{i}" for i in range(50)]
+    sents = [" ".join(rng.choice(vocab, 12)) for _ in range(60)]
+
+    def train():
+        w = Word2Vec(vector_length=16, window=3, negative=0, epochs=2,
+                     batch_size=64, seed=9)
+        return w.fit(sents).syn0
+
+    baseline = train()
+    monkeypatch.setattr(w2v_mod, "STAGE_PAIRS", 128)  # 2 batches/chunk
+    tiny_chunks = train()
+    np.testing.assert_array_equal(baseline, tiny_chunks)

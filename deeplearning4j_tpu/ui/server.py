@@ -268,19 +268,34 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             import jax
 
-            from deeplearning4j_tpu.parallel import generate
+            from deeplearning4j_tpu.parallel import beam_search, generate
 
             cfg, params = lm
             prompt = body.get("prompt_ids")
             if not prompt:
                 self._json(400, {"error": "prompt_ids required"})
                 return
-            temperature = float(body.get("temperature", 0.0))
-            out = generate(
-                cfg, params, np.asarray([prompt], np.int32),
-                max_new_tokens=int(body.get("max_new_tokens", 32)),
-                temperature=temperature,
-                rng=jax.random.PRNGKey(int(body.get("seed", 0))))
+            try:
+                ids = np.asarray([prompt], np.int32)
+                max_new = int(body.get("max_new_tokens", 32))
+                beams = int(body.get("beam_size", 0))
+                if beams > 1:
+                    out, scores = beam_search(cfg, params, ids,
+                                              max_new_tokens=max_new,
+                                              beam_size=beams)
+                    self._json(200, {"ids": np.asarray(out)[0].tolist(),
+                                     "score": float(scores[0])})
+                    return
+                out = generate(
+                    cfg, params, ids, max_new_tokens=max_new,
+                    temperature=float(body.get("temperature", 0.0)),
+                    top_k=int(body.get("top_k", 0)),
+                    top_p=float(body.get("top_p", 1.0)),
+                    rng=jax.random.PRNGKey(int(body.get("seed", 0))))
+            except (ValueError, TypeError) as e:
+                # bad prompt/params (incl. null/list-valued knobs) -> 400
+                self._json(400, {"error": str(e)})
+                return
             self._json(200, {"ids": np.asarray(out)[0].tolist()})
         else:
             self._json(404, {"error": f"unknown path {self.path}"})

@@ -151,6 +151,28 @@ def test_lm_generate_endpoint():
         assert len(out["ids"]) == 7
         assert out["ids"][:3] == [1, 2, 3]
         assert all(0 <= t < 50 for t in out["ids"])
+        sampled = _post(srv.url + "/lm/generate",
+                        {"prompt_ids": [1, 2, 3], "max_new_tokens": 4,
+                         "temperature": 1.0, "top_k": 5, "top_p": 0.9})
+        assert len(sampled["ids"]) == 7
+        beamed = _post(srv.url + "/lm/generate",
+                       {"prompt_ids": [1, 2, 3], "max_new_tokens": 4,
+                        "beam_size": 3})
+        assert len(beamed["ids"]) == 7 and "score" in beamed
+        assert beamed["ids"][:3] == [1, 2, 3]
+        # beam_size <= 1 routes to the plain (greedy) generate path
+        one = _post(srv.url + "/lm/generate",
+                    {"prompt_ids": [1, 2, 3], "max_new_tokens": 4,
+                     "beam_size": 1})
+        assert "score" not in one and len(one["ids"]) == 7
+        # malformed knob values are client errors, not dropped connections
+        import urllib.error
+        try:
+            _post(srv.url + "/lm/generate",
+                  {"prompt_ids": [1, 2, 3], "max_new_tokens": None})
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
     finally:
         srv.stop()
 

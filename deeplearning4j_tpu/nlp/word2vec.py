@@ -40,11 +40,10 @@ from typing import Iterable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.mesh import (
     round_batch_to_mesh,
-    shard_map_compat,
+    sparse_allgather_step,
 )
 
 from deeplearning4j_tpu.nlp.tokenization import (
@@ -243,7 +242,7 @@ class Word2Vec(WordVectors):
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def hs_step(syn0, syn1, inputs, targets, lr, key, valid):
-            return step_core(syn0, syn1, inputs, targets, lr, valid)
+            return step_core(syn0, syn1, lr, inputs, targets, valid)
 
         return hs_step
 
@@ -287,50 +286,32 @@ class Word2Vec(WordVectors):
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def neg_step(syn0, syn1neg, inputs, targets, lr, key, valid):
-            return step_core(syn0, syn1neg, inputs, targets, lr, valid, key)
+            return step_core(syn0, syn1neg, lr, inputs, targets, valid,
+                             key)
 
         return neg_step
 
     def _sparse_step(self, deltas_fn, with_key: bool):
-        """Turn a sparse-delta fn into the full table-update step.
+        """Turn a sparse-delta fn into the full table-update step via the
+        shared `sparse_allgather_step` harness: single device scatter-adds
+        `lr * delta` into the touched rows; with a mesh, the pair batch
+        shards over the first axis (the documented TPU-native Hogwild,
+        `Word2Vec.java:145-258`), the (rows, deltas) pairs are
+        all_gathered — O(B·D) over ICI instead of a dense O(V·D) psum —
+        and every replica applies the identical scatter."""
 
-        Single device: scatter-add `lr * delta` into the touched rows.
-        Mesh: shard the pair batch over the mesh's first axis inside
-        shard_map (the documented TPU-native Hogwild,
-        `Word2Vec.java:145-258`), `all_gather` every shard's (rows,
-        deltas) — O(B·D) over ICI instead of a dense O(V·D) psum — and
-        every replica applies the identical full scatter, so the
-        replicated tables never diverge."""
-        mesh = self.mesh
+        def deltas(syn0, syn1, lr, inputs, targets, valid, *key):
+            loss, p0, p1 = deltas_fn(syn0, syn1, inputs, targets, valid,
+                                     *key)
+            return loss, (p0, p1)
 
-        def apply(syn0, syn1, inputs, targets, lr, valid, *key):
-            loss, (r0, d0), (r1, d1) = deltas_fn(
-                syn0, syn1, inputs, targets, valid, *key)
-            syn0 = syn0.at[r0].add(lr * d0)
-            syn1 = syn1.at[r1].add(lr * d1)
-            return syn0, syn1, loss
+        def apply(syn0, syn1, lr, aux):
+            (r0, d0), (r1, d1) = aux
+            return (syn0.at[r0].add(lr * d0), syn1.at[r1].add(lr * d1))
 
-        if mesh is None:
-            return apply
-        axis = mesh.axis_names[0]
-
-        def sharded(syn0, syn1, inputs, targets, lr, valid, *key):
-            if key:
-                key = (jax.random.fold_in(
-                    key[0], jax.lax.axis_index(axis)),)
-            loss, (r0, d0), (r1, d1) = deltas_fn(
-                syn0, syn1, inputs, targets, valid, *key)
-            loss = jax.lax.psum(loss, axis)
-            r0, d0, r1, d1 = (jax.lax.all_gather(a, axis, tiled=True)
-                              for a in (r0, d0, r1, d1))
-            syn0 = syn0.at[r0].add(lr * d0)
-            syn1 = syn1.at[r1].add(lr * d1)
-            return syn0, syn1, loss
-
-        in_specs = (P(), P(), P(axis), P(axis), P(), P(axis)) + (
-            (P(),) if with_key else ())
-        return shard_map_compat(sharded, mesh=mesh, in_specs=in_specs,
-                                out_specs=(P(), P(), P()))
+        return sparse_allgather_step(self.mesh, deltas, apply, n_state=2,
+                                     n_scalar=1, n_sharded=3,
+                                     with_key=with_key)
 
     # ------------------------------------------------------------------
     # fit (reference Word2Vec.fit():103)

@@ -107,3 +107,49 @@ def data_parallel_grads(fn, mesh: Mesh, n_replicated: int, n_sharded: int,
                 + ((P(),) if with_key else ()))
     return shard_map_compat(local, mesh=mesh, in_specs=in_specs,
                             out_specs=P())
+
+
+def sparse_allgather_step(mesh: Optional[Mesh], deltas_fn, apply_fn,
+                          n_state: int, n_sharded: int, n_scalar: int = 0,
+                          with_key: bool = False):
+    """Sparse-update counterpart of `data_parallel_grads` (shared by
+    Word2Vec and GloVe `mesh=`): builds ``step(*state, *scalars,
+    *sharded[, key]) -> (*new_state, loss)`` where
+
+    - ``deltas_fn(same args) -> (loss, aux)`` computes per-shard sparse
+      pieces (aux: any pytree of [B, ...] arrays — row indices, deltas),
+    - ``apply_fn(*state, *scalars, aux) -> new_state tuple`` scatters
+      them into the replicated state.
+
+    mesh=None applies directly.  With a mesh, the trailing ``n_sharded``
+    args shard over the FIRST axis, loss is psum'd, aux is all_gathered
+    (tiled — O(B) comms, never a dense table), and every replica applies
+    the identical scatter, so replicated state never diverges.  with_key
+    folds the axis index into a trailing PRNG key."""
+
+    def single(*args):
+        state = args[:n_state]
+        lead = args[:n_state + n_scalar]
+        loss, aux = deltas_fn(*args)
+        return (*apply_fn(*lead, aux), loss)
+
+    if mesh is None:
+        return single
+    axis = mesh.axis_names[0]
+
+    def sharded(*args):
+        if with_key:
+            *rest, key = args
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+            args = (*rest, key)
+        lead = args[:n_state + n_scalar]
+        loss, aux = deltas_fn(*args)
+        loss = jax.lax.psum(loss, axis)
+        aux = jax.tree_util.tree_map(
+            lambda a: jax.lax.all_gather(a, axis, tiled=True), aux)
+        return (*apply_fn(*lead, aux), loss)
+
+    in_specs = ((P(),) * (n_state + n_scalar) + (P(axis),) * n_sharded
+                + ((P(),) if with_key else ()))
+    return shard_map_compat(sharded, mesh=mesh, in_specs=in_specs,
+                            out_specs=P())

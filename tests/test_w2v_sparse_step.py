@@ -141,3 +141,54 @@ def test_multi_chunk_staging_matches_single_chunk(monkeypatch):
     monkeypatch.setattr(w2v_mod, "STAGE_PAIRS", 128)  # 2 batches/chunk
     tiny_chunks = train()
     np.testing.assert_array_equal(baseline, tiny_chunks)
+
+
+def test_glove_sparse_adagrad_matches_numpy_oracle():
+    """One sparse GloVe step == a straightforward numpy rendering of the
+    same semantics: scatter g^2 into the AdaGrad accumulators first, then
+    every entry divides by its row's batch-inclusive denominator."""
+    from deeplearning4j_tpu.nlp.glove import Glove
+
+    rng = np.random.default_rng(0)
+    sents = [" ".join(f"w{i}" for i in rng.integers(0, 30, 8))
+             for _ in range(30)]
+    g = Glove(vector_length=8, window=3, epochs=1, batch_size=64, seed=1)
+    g.vocab.fit(g._tokenize_all(sents))
+    g._init_params()
+    v = len(g.vocab)
+    b = 64
+    ii = rng.integers(0, v, b).astype(np.int32)
+    jj = rng.integers(0, v, b).astype(np.int32)
+    xx = rng.random(b).astype(np.float32) * 5 + 0.5
+    valid = (rng.random(b) < 0.9).astype(np.float32)
+    lr, eps = g.learning_rate, 1e-8
+
+    params = [np.asarray(p) for p in g._params]
+    ada = [np.asarray(h) for h in g._adagrad]
+    w, wc, bb, bc = params
+    diff = (np.sum(w[ii] * wc[jj], 1) + bb[ii] + bc[jj] - np.log(xx))
+    fx = np.minimum((xx / g.x_max) ** g.alpha, 1.0)
+    e = valid * fx * diff
+    loss_ref = 0.5 * np.sum(e * diff)
+    grads = [e[:, None] * wc[jj], e[:, None] * w[ii], e, e]
+    rows = [ii, jj, ii, jj]
+    want_p, want_h = [], []
+    for p, h, r, gr in zip(params, ada, rows, grads):
+        h = h.copy()
+        np.add.at(h, r, gr * gr)
+        upd = np.zeros_like(p)
+        np.add.at(upd, r, -lr * gr / np.sqrt(h[r] + eps))
+        want_p.append(p + upd)
+        want_h.append(h)
+
+    import jax
+    import jax.numpy as jnp
+
+    got_p, got_h, loss = g._step(
+        g._params, g._adagrad, jnp.asarray(ii), jnp.asarray(jj),
+        jnp.asarray(xx), jnp.asarray(valid))
+    np.testing.assert_allclose(float(loss), loss_ref, rtol=1e-5)
+    for got, want in zip(got_p, want_p):
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+    for got, want in zip(got_h, want_h):
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)

@@ -134,6 +134,29 @@ def char_lstm(vocab_size: int = 80, hidden: int = 256,
     )
 
 
+def dbn_mnist(layer_sizes: tuple = (784, 256, 128), n_out: int = 10,
+              updater: str = "sgd", learning_rate: float = 0.05,
+              k: int = 1, seed: int = 0) -> MultiLayerConfiguration:
+    """Deep belief network for MNIST-shaped data: stacked binary RBMs
+    with greedy layer-wise CD-k pretraining, finetuned through a softmax
+    head — THE flagship model family of the 2015 reference (its tests
+    and examples train DBNs, e.g. `MultiLayerTest.java:163` testDbn;
+    pretrain flag `MultiLayerConfiguration.java:50`, greedy loop
+    `MultiLayerNetwork.pretrain():148`)."""
+    from deeplearning4j_tpu.nn.conf.layers import RBMConf
+
+    rbms = tuple(
+        RBMConf(n_in=layer_sizes[i], n_out=layer_sizes[i + 1], k=k,
+                visible_unit="binary", hidden_unit="binary")
+        for i in range(len(layer_sizes) - 1))
+    return MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=learning_rate,
+                                    updater=updater, seed=seed),
+        layers=rbms + (OutputLayerConf(n_in=layer_sizes[-1], n_out=n_out),),
+        pretrain=True,
+    )
+
+
 def iris_mlp(updater: str = "adam", learning_rate: float = 0.02,
              seed: int = 3) -> MultiLayerConfiguration:
     """3-layer MLP for Iris (BASELINE.md config #2, the CLI convergence
@@ -153,6 +176,7 @@ ZOO = {
     "alexnet-cifar10": alexnet_cifar10,
     "char-lstm": char_lstm,
     "iris-mlp": iris_mlp,
+    "dbn-mnist": dbn_mnist,
 }
 
 

@@ -82,33 +82,6 @@ def round_batch_to_mesh(batch_size: int, mesh: Mesh) -> int:
     return ((batch_size + n - 1) // n) * n
 
 
-def data_parallel_grads(fn, mesh: Mesh, n_replicated: int, n_sharded: int,
-                        with_key: bool = False):
-    """Shared data-parallel gradient wrapper (Word2Vec/GloVe mesh=):
-    wraps ``fn(*replicated, *sharded[, key]) -> pytree`` in shard_map —
-    leading args replicated, trailing args sharded over the mesh's FIRST
-    axis, every output leaf psum'd — so each replica holds identical
-    results and applies one identical update.  with_key folds the axis
-    index into a trailing PRNG key (per-shard randomness, e.g. negative
-    sampling)."""
-    axis = mesh.axis_names[0]
-
-    def local(*args):
-        if with_key:
-            *rest, key = args
-            key = jax.random.fold_in(key, jax.lax.axis_index(axis))
-            out = fn(*rest, key)
-        else:
-            out = fn(*args)
-        return jax.tree_util.tree_map(
-            lambda a: jax.lax.psum(a, axis), out)
-
-    in_specs = ((P(),) * n_replicated + (P(axis),) * n_sharded
-                + ((P(),) if with_key else ()))
-    return shard_map_compat(local, mesh=mesh, in_specs=in_specs,
-                            out_specs=P())
-
-
 def sparse_allgather_step(mesh: Optional[Mesh], deltas_fn, apply_fn,
                           n_state: int, n_sharded: int, n_scalar: int = 0,
                           with_key: bool = False):
@@ -128,7 +101,6 @@ def sparse_allgather_step(mesh: Optional[Mesh], deltas_fn, apply_fn,
     folds the axis index into a trailing PRNG key."""
 
     def single(*args):
-        state = args[:n_state]
         lead = args[:n_state + n_scalar]
         loss, aux = deltas_fn(*args)
         return (*apply_fn(*lead, aux), loss)

@@ -41,7 +41,34 @@ def test_alexnet_cifar10_shapes_and_step():
 
 
 def test_zoo_configs_serde_roundtrip():
-    for name in ("lenet-mnist", "alexnet-cifar10", "char-lstm", "iris-mlp"):
+    for name in ("lenet-mnist", "lenet-digits", "alexnet-cifar10",
+                 "char-lstm", "iris-mlp", "dbn-mnist"):
         conf = get_model(name)
         back = MultiLayerConfiguration.from_json(conf.to_json())
         assert back == conf, name
+
+
+def test_dbn_pretrains_and_classifies_real_digits():
+    """zoo:dbn-mnist (the reference's flagship DBN family,
+    MultiLayerTest.java:163 testDbn): greedy CD-k pretraining over the
+    stacked RBMs runs, then finetuning reaches >= 0.90 on REAL held-out
+    digits."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.fetchers import digits_dataset
+    from deeplearning4j_tpu.models import MultiLayerNetwork, get_model
+
+    train = digits_dataset("train", flatten=True)
+    test = digits_dataset("test", flatten=True)
+    conf = get_model("dbn-mnist", layer_sizes=(64, 48, 32),
+                     learning_rate=0.1, updater="adam")
+    assert conf.pretrain and len(conf.layers) == 3
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    order = rng.permutation(len(train.features))
+    batches = [(train.features[order[i:i + 128]],
+                train.labels[order[i:i + 128]])
+               for i in range(0, len(order) - 127, 128)]
+    net.fit(batches, epochs=12)
+    acc = net.evaluate(test.features, test.labels).accuracy()
+    assert acc >= 0.90, f"DBN digits accuracy {acc:.4f} < 0.90"

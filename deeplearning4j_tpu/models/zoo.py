@@ -157,6 +157,38 @@ def dbn_mnist(layer_sizes: tuple = (784, 256, 128), n_out: int = 10,
     )
 
 
+def deep_autoencoder(layer_sizes: tuple = (784, 256, 64, 16),
+                     updater: str = "adam", learning_rate: float = 1e-3,
+                     corruption_level: float = 0.0, seed: int = 0
+                     ) -> MultiLayerConfiguration:
+    """Deep (denoising) autoencoder: stacked AE layers pretrained
+    greedily, then the full encoder-decoder finetuned on reconstruction
+    — the reference's Curves workload (`CurvesDataFetcher` feeding
+    stacked `autoencoder/AutoEncoder.java` layers;
+    `ReconstructionDataSetIterator` supplies labels=features).  The
+    decoder mirrors the encoder; the sigmoid head + xent loss fit
+    [0,1]-valued inputs (curves pixels, MNIST)."""
+    from deeplearning4j_tpu.nn.conf.layers import AutoEncoderConf
+
+    encoder = tuple(
+        AutoEncoderConf(n_in=layer_sizes[i], n_out=layer_sizes[i + 1],
+                        corruption_level=corruption_level,
+                        activation="sigmoid")
+        for i in range(len(layer_sizes) - 1))
+    decoder = tuple(
+        DenseLayerConf(n_in=layer_sizes[i + 1], n_out=layer_sizes[i],
+                       activation="sigmoid")
+        for i in reversed(range(1, len(layer_sizes) - 1)))
+    head = OutputLayerConf(n_in=layer_sizes[1], n_out=layer_sizes[0],
+                           activation="sigmoid", loss="xent")
+    return MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=learning_rate,
+                                    updater=updater, seed=seed),
+        layers=encoder + decoder + (head,),
+        pretrain=True,
+    )
+
+
 def iris_mlp(updater: str = "adam", learning_rate: float = 0.02,
              seed: int = 3) -> MultiLayerConfiguration:
     """3-layer MLP for Iris (BASELINE.md config #2, the CLI convergence
@@ -177,6 +209,7 @@ ZOO = {
     "char-lstm": char_lstm,
     "iris-mlp": iris_mlp,
     "dbn-mnist": dbn_mnist,
+    "deep-autoencoder": deep_autoencoder,
 }
 
 

@@ -85,7 +85,7 @@ def round_batch_to_mesh(batch_size: int, mesh: Mesh) -> int:
 def sparse_allgather_step(mesh: Optional[Mesh], deltas_fn, apply_fn,
                           n_state: int, n_sharded: int, n_scalar: int = 0,
                           with_key: bool = False):
-    """Sparse-update counterpart of `data_parallel_grads` (shared by
+    """Data-parallel harness for sparse embedding updates (shared by
     Word2Vec and GloVe `mesh=`): builds ``step(*state, *scalars,
     *sharded[, key]) -> (*new_state, loss)`` where
 

@@ -42,7 +42,7 @@ def test_alexnet_cifar10_shapes_and_step():
 
 def test_zoo_configs_serde_roundtrip():
     for name in ("lenet-mnist", "lenet-digits", "alexnet-cifar10",
-                 "char-lstm", "iris-mlp", "dbn-mnist"):
+                 "char-lstm", "iris-mlp", "dbn-mnist", "deep-autoencoder"):
         conf = get_model(name)
         back = MultiLayerConfiguration.from_json(conf.to_json())
         assert back == conf, name
@@ -72,3 +72,25 @@ def test_dbn_pretrains_and_classifies_real_digits():
     net.fit(batches, epochs=12)
     acc = net.evaluate(test.features, test.labels).accuracy()
     assert acc >= 0.90, f"DBN digits accuracy {acc:.4f} < 0.90"
+
+
+def test_deep_autoencoder_reconstructs_curves():
+    """zoo:deep-autoencoder (reference Curves deep-AE workload): greedy
+    AE pretraining + end-to-end reconstruction finetuning must cut the
+    reconstruction loss by >=2x and emit [0,1] images."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.fetchers import curves_dataset
+    from deeplearning4j_tpu.models import MultiLayerNetwork, get_model
+
+    x = np.asarray(curves_dataset(n=2048).features)
+    net = MultiLayerNetwork(
+        get_model("deep-autoencoder", layer_sizes=(784, 128, 32))).init()
+    before = net.score(x, x)
+    batches = [(x[i:i + 256], x[i:i + 256]) for i in range(0, len(x), 256)]
+    net.fit(batches, epochs=6)
+    after = net.score(x, x)
+    assert after < 0.5 * before, (before, after)
+    rec = np.asarray(net.output(x[:8]))
+    assert rec.shape == (8, 784)
+    assert (rec >= 0).all() and (rec <= 1).all()

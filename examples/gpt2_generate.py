@@ -16,7 +16,7 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 import jax
 
-from deeplearning4j_tpu.parallel import generate
+from deeplearning4j_tpu.parallel import beam_search, generate
 from deeplearning4j_tpu.runtime.model_import import import_hf_gpt2
 
 
@@ -46,9 +46,16 @@ def main():
     else:
         prompt_ids = [[11, 42, 7]]
     out = generate(cfg, params, prompt_ids, max_new_tokens=32,
-                   temperature=0.8, rng=jax.random.PRNGKey(0))
+                   temperature=0.8, top_p=0.9,
+                   rng=jax.random.PRNGKey(0))
     ids = out[0].tolist()
-    print(tok.decode(ids) if tok is not None else ids)
+    print("nucleus:", tok.decode(ids) if tok is not None else ids)
+
+    toks, scores = beam_search(cfg, params, prompt_ids,
+                               max_new_tokens=32, beam_size=4)
+    ids = toks[0].tolist()
+    print(f"beam (logp {float(scores[0]):.2f}):",
+          tok.decode(ids) if tok is not None else ids)
 
 
 if __name__ == "__main__":

@@ -41,8 +41,9 @@ def test_alexnet_cifar10_shapes_and_step():
 
 
 def test_zoo_configs_serde_roundtrip():
-    for name in ("lenet-mnist", "lenet-digits", "alexnet-cifar10",
-                 "char-lstm", "iris-mlp", "dbn-mnist", "deep-autoencoder"):
+    from deeplearning4j_tpu.models import ZOO
+
+    for name in sorted(ZOO):
         conf = get_model(name)
         back = MultiLayerConfiguration.from_json(conf.to_json())
         assert back == conf, name

@@ -119,3 +119,41 @@ class TestWord2VecSimilarityGate:
     def test_nearest_words_exclude_self_and_are_ranked(self, trained):
         near = trained.words_nearest("array", top_n=5)
         assert len(near) == 5 and "array" not in near
+
+
+class TestTransformerLmGate:
+    """The flagship TransformerLM must actually learn real English text:
+    byte-level LM on this repo's docs, loss must drop substantially."""
+
+    def test_transformer_lm_loss_decreases(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.parallel import transformer as tfm
+
+        text = (REPO / "README.md").read_bytes()
+        ids = np.frombuffer(text, np.uint8).astype(np.int32)
+        cfg = tfm.TransformerConfig(vocab_size=256, d_model=64, n_heads=4,
+                                    n_layers=2, d_ff=128, max_len=64)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+
+        @jax.jit
+        def step(p, tokens, targets):
+            loss, grads = jax.value_and_grad(
+                lambda q: tfm.lm_loss(cfg, q, tokens, targets))(p)
+            return jax.tree_util.tree_map(
+                lambda w, g: w - 1e-2 * g, p, grads), loss
+
+        rng = np.random.default_rng(0)
+        b, s = 8, 64
+        losses = []
+        for _ in range(200):
+            starts = rng.integers(0, len(ids) - s - 1, b)
+            tokens = jnp.asarray(np.stack([ids[i:i + s] for i in starts]))
+            targets = jnp.asarray(
+                np.stack([ids[i + 1:i + s + 1] for i in starts]))
+            params, loss = step(params, tokens, targets)
+            losses.append(float(loss))
+        first, last = np.mean(losses[:5]), np.mean(losses[-5:])
+        assert last < 0.7 * first, (first, last)
+        assert np.isfinite(losses).all()

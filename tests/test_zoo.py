@@ -43,6 +43,7 @@ def test_alexnet_cifar10_shapes_and_step():
 def test_zoo_configs_serde_roundtrip():
     from deeplearning4j_tpu.models import ZOO
 
+    assert len(ZOO) >= 7  # removals must be deliberate, not silent
     for name in sorted(ZOO):
         conf = get_model(name)
         back = MultiLayerConfiguration.from_json(conf.to_json())

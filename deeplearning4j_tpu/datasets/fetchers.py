@@ -26,7 +26,7 @@ import gzip
 import os
 import struct
 from pathlib import Path
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 

@@ -10,7 +10,7 @@ examples carry slightly more weight that epoch), or dropped with
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Iterator
 
 import numpy as np
 

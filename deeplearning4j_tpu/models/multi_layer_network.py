@@ -38,7 +38,6 @@ from deeplearning4j_tpu.nn.layers import get_layer_impl
 from deeplearning4j_tpu.nn.layers.pretrain import (
     ae_pretrain_loss,
     rbm_cd_grads,
-    rbm_pretrain_loss,
 )
 from deeplearning4j_tpu.ops import losses as losses_mod
 from deeplearning4j_tpu.ops.updaters import apply_updates, make_updater

@@ -10,7 +10,7 @@ weights (a capability the reference lacked but doc2vec users expect).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -9,8 +9,6 @@ Parity: reference `models/embeddings/loader/WordVectorSerializer.java:76` —
 from __future__ import annotations
 
 import os
-import pathlib
-from typing import TYPE_CHECKING, Tuple
 
 import numpy as np
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import re
 import unicodedata
-from typing import Callable, Iterable, List, Optional
+from typing import Callable, List, Optional
 
 
 class TokenPreProcess:

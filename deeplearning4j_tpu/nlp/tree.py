@@ -18,7 +18,7 @@ padded to a common length — which `lax.scan` executes on device
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import numpy as np
 

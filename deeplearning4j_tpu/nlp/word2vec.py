@@ -35,7 +35,7 @@ sparse per-pair saxpy updates, racy across a thread pool (Hogwild). Here:
 from __future__ import annotations
 
 import functools
-from typing import Iterable, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,7 @@ Shape/layout conventions (TPU-first, differ deliberately from the reference):
 from __future__ import annotations
 
 import dataclasses
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple, Type
 
 _LAYER_TYPES: Dict[str, Type["LayerConf"]] = {}

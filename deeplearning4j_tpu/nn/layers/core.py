@@ -9,7 +9,6 @@ the bias add + activation into the matmul epilogue.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

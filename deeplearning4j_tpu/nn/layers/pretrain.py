@@ -15,7 +15,7 @@ supervised finetune, reference MultiLayerNetwork.pretrain :148).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,6 @@ unchanged.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -14,7 +14,7 @@ MultiLayerNetwork + batch into that form via its unravel view.
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence
+from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp

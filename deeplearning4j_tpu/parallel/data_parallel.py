@@ -26,8 +26,6 @@ Design notes (scaling-book recipe):
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp

@@ -8,7 +8,7 @@ serializable work + result), `JobIterator`, `WorkerPerformer.java`
 from __future__ import annotations
 
 import itertools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Iterator, List, Optional
 
 

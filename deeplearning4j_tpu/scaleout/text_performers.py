@@ -7,7 +7,6 @@ work (SURVEY §2.2 "Scaleout performers" row; `WordCountTest`).
 
 from __future__ import annotations
 
-from typing import Iterable, List
 
 from deeplearning4j_tpu.scaleout.api import Job, JobAggregator, WorkerPerformer
 from deeplearning4j_tpu.utils.counter import Counter

@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import json
 import urllib.request
-from typing import Optional
 
 import numpy as np
 

@@ -7,7 +7,7 @@ minimal installs raise a clear error instead of importing eagerly.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 

@@ -1,0 +1,139 @@
+"""Property-based invariants (hypothesis) over the ops/config tiers.
+
+The reference's gold-standard tests assert hand-computed expectations
+(`BackPropMLPTest.java:70`); these generalize that idea: invariants that
+must hold for EVERY config/shape/seed, not one worked example.  Shapes
+stay tiny and example counts modest so the jit cost stays bounded.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayerConf,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayerConf,
+)
+from deeplearning4j_tpu.ops.updaters import (
+    Updater,
+    UpdaterConfig,
+    apply_updates,
+    make_updater,
+)
+
+SETTINGS = settings(max_examples=15, deadline=None)
+
+ACTIVATIONS = st.sampled_from(["relu", "tanh", "sigmoid", "elu", "gelu"])
+UPDATERS = st.sampled_from([u.value for u in Updater if u != Updater.NONE])
+SIZES = st.integers(min_value=1, max_value=9)
+
+
+@st.composite
+def mlp_confs(draw):
+    n_in = draw(SIZES)
+    hidden = draw(st.lists(SIZES, min_size=0, max_size=3))
+    n_out = draw(SIZES)
+    sizes = [n_in] + hidden + [n_out]
+    layers = tuple(
+        DenseLayerConf(n_in=sizes[i], n_out=sizes[i + 1],
+                       activation=draw(ACTIVATIONS))
+        for i in range(len(sizes) - 2)
+    ) + (OutputLayerConf(n_in=sizes[-2], n_out=sizes[-1]),)
+    conf = NeuralNetConfiguration(
+        learning_rate=draw(st.floats(1e-4, 0.5)),
+        updater=draw(UPDATERS),
+        seed=draw(st.integers(0, 2**31 - 1)),
+        l1=draw(st.sampled_from([0.0, 1e-4])),
+        l2=draw(st.sampled_from([0.0, 1e-4])),
+    )
+    return MultiLayerConfiguration(conf=conf, layers=layers)
+
+
+@SETTINGS
+@given(mlp_confs())
+def test_config_json_roundtrip_any_mlp(conf):
+    """to_json -> from_json is the identity for ANY generated config —
+    the shipping-format contract every distributed runtime depends on."""
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back == conf
+
+
+@SETTINGS
+@given(mlp_confs(), st.integers(0, 2**31 - 1))
+def test_params_flat_roundtrip_any_mlp(conf, seed):
+    """params_flat -> set_params_flat restores every weight exactly for
+    ANY architecture (the checkpoint/shipping format)."""
+    net = MultiLayerNetwork(conf).init(jax.random.PRNGKey(seed))
+    vec = net.params_flat()
+    clone = MultiLayerNetwork(conf).init()
+    clone.set_params_flat(vec)
+    np.testing.assert_array_equal(vec, clone.params_flat())
+    assert vec.size == net.num_params()
+
+
+@SETTINGS
+@given(UPDATERS, st.integers(0, 1000))
+def test_zero_gradient_is_a_fixed_point(updater, seed):
+    """With no regularization, every updater must leave params unchanged
+    when the gradient is exactly zero (reference BaseUpdater contract:
+    postApply only adds penalty terms, which are off here)."""
+    rng = np.random.default_rng(seed)
+    params = {"w": jnp.asarray(rng.standard_normal((3, 4)), jnp.float32)}
+    cfg = UpdaterConfig(updater=Updater(updater), learning_rate=0.1)
+    tx = make_updater(cfg)
+    state = tx.init(params)
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    updates, _ = tx.update(zeros, state, params)
+    new = apply_updates(params, updates)
+    np.testing.assert_allclose(np.asarray(new["w"]),
+                               np.asarray(params["w"]), atol=1e-7)
+
+
+@SETTINGS
+@given(st.integers(0, 1000))
+def test_sgd_descends_a_quadratic(seed):
+    """One SGD step on f(w)=0.5||w||^2 must strictly reduce f for any
+    start point (sanity anchor for the updater pipeline)."""
+    rng = np.random.default_rng(seed)
+    w = jnp.asarray(rng.standard_normal((5,)) + 0.1, jnp.float32)
+    cfg = UpdaterConfig(updater=Updater.SGD, learning_rate=0.1)
+    tx = make_updater(cfg)
+    state = tx.init({"w": w})
+    grads = {"w": w}  # grad of 0.5||w||^2
+    updates, _ = tx.update(grads, state, {"w": w})
+    new = apply_updates({"w": w}, updates)["w"]
+    assert float(jnp.sum(new ** 2)) < float(jnp.sum(w ** 2))
+
+
+@SETTINGS
+@given(st.integers(0, 1000), st.integers(1, 6), st.integers(1, 6))
+def test_softmax_rows_are_distributions(seed, b, k):
+    from deeplearning4j_tpu.ops.activations import get_activation
+
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((b, k)) * 5, jnp.float32)
+    p = np.asarray(get_activation("softmax")(x))
+    assert (p >= 0).all()
+    np.testing.assert_allclose(p.sum(axis=-1), 1.0, atol=1e-5)
+
+
+@SETTINGS
+@given(st.integers(0, 1000), st.integers(1, 5), st.integers(2, 5))
+def test_losses_nonnegative_and_zero_at_target(seed, b, k):
+    """mse(y,y)==0; mcxent_with_logits is nonnegative and minimized by
+    logits matching the one-hot target direction."""
+    from deeplearning4j_tpu.ops.losses import get_loss
+
+    rng = np.random.default_rng(seed)
+    y = jnp.asarray(np.eye(k, dtype=np.float32)[rng.integers(0, k, b)])
+    assert float(get_loss("mse")(y, y)) == 0.0
+    logits = jnp.asarray(rng.standard_normal((b, k)), jnp.float32)
+    loss = float(get_loss("mcxent_with_logits")(y, logits))
+    assert loss >= 0.0
+    sharp = float(get_loss("mcxent_with_logits")(y, y * 50.0))
+    assert sharp < loss + 1e-6 or sharp < 1e-3

@@ -3,6 +3,7 @@ from deeplearning4j_tpu.datasets.iterators import (
     ArrayDataSetIterator,
     DataSetIterator,
     MultipleEpochsIterator,
+    BucketedSequenceIterator,
     PrefetchDataSetIterator,
     ReconstructionDataSetIterator,
     SamplingDataSetIterator,
@@ -11,5 +12,6 @@ from deeplearning4j_tpu.datasets.iterators import (
 __all__ = [
     "DataSet", "DataSetIterator", "ArrayDataSetIterator",
     "MultipleEpochsIterator", "SamplingDataSetIterator",
-    "PrefetchDataSetIterator", "ReconstructionDataSetIterator",
+    "BucketedSequenceIterator", "PrefetchDataSetIterator",
+    "ReconstructionDataSetIterator",
 ]

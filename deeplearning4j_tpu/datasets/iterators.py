@@ -193,3 +193,100 @@ class PrefetchDataSetIterator(DataSetIterator):
 
     def total_examples(self):
         return self.base.total_examples()
+
+
+class BucketedSequenceIterator(DataSetIterator):
+    """Length-bucketed batching for variable-length sequences.
+
+    The reference pads every sequence to the batch maximum and loops
+    timesteps in Java, so padding waste is invisible there; under XLA
+    every distinct padded length is a separate compilation AND wasted
+    MXU work.  This iterator groups sequences into length buckets
+    (boundaries default to powers of two), pads only to the bucket
+    ceiling, and emits [batch, T_bucket, ...] DataSets with [batch, T]
+    masks — at most one compilation per (bucket, batch-size) pair and
+    bounded pad waste.
+
+    sequences: list of [T_i, F] float arrays; labels: list of matching
+    [T_i, C] (per-step) or [C] (per-sequence) arrays.
+    """
+
+    def __init__(self, sequences, labels, batch_size: int = 32,
+                 boundaries=None, seed: int = 0,
+                 drop_remainder: bool = False):
+        if len(sequences) != len(labels):
+            raise ValueError("sequences and labels must align")
+        if not sequences:
+            raise ValueError("no sequences")
+        self.sequences = [np.asarray(s, np.float32) for s in sequences]
+        self.labels = [np.asarray(y, np.float32) for y in labels]
+        self.batch = batch_size
+        self.drop_remainder = drop_remainder
+        self.seed = seed
+        self._epoch = 0
+        max_len = max(len(s) for s in self.sequences)
+        if boundaries is None:
+            boundaries, b = [], 8
+            while b < max_len:
+                boundaries.append(b)
+                b *= 2
+        self.boundaries = sorted(set(list(boundaries) + [max_len]))
+
+    def _bucket_of(self, n: int) -> int:
+        for b in self.boundaries:
+            if n <= b:
+                return b
+        return self.boundaries[-1]
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed + self._epoch)
+        self._epoch += 1
+        buckets = {}
+        for i, s in enumerate(self.sequences):
+            buckets.setdefault(self._bucket_of(len(s)), []).append(i)
+        order = []
+        for bound in sorted(buckets):
+            idx = np.asarray(buckets[bound])
+            rng.shuffle(idx)
+            for k in range(0, len(idx), self.batch):
+                sel = idx[k:k + self.batch]
+                if len(sel) < self.batch:
+                    if self.drop_remainder:
+                        continue
+                    # Pad to batch size with wraparound from the same
+                    # bucket (module convention, see ArrayDataSetIterator)
+                    # so shapes stay static: one compile per bucket.
+                    sel = np.concatenate(
+                        [sel, idx[np.arange(self.batch - len(sel))
+                                  % len(idx)]])
+                order.append((bound, sel))
+        rng.shuffle(order)
+        for bound, sel in order:
+            n = len(sel)
+            feat_dim = self.sequences[sel[0]].shape[1:]
+            x = np.zeros((n, bound) + feat_dim, np.float32)
+            mask = np.zeros((n, bound), np.float32)
+            per_step = self.labels[sel[0]].ndim > 1
+            if per_step:
+                y = np.zeros((n, bound) + self.labels[sel[0]].shape[1:],
+                             np.float32)
+            else:
+                y = np.zeros((n,) + self.labels[sel[0]].shape, np.float32)
+            for row, i in enumerate(sel):
+                t = len(self.sequences[i])
+                x[row, :t] = self.sequences[i]
+                mask[row, :t] = 1.0
+                if per_step:
+                    y[row, :t] = self.labels[i]
+                else:
+                    y[row] = self.labels[i]
+            yield DataSet(x, y, mask=mask)
+
+    def reset(self) -> None:
+        pass  # each __iter__ reshuffles with a fresh epoch seed
+
+    def batch_size(self) -> int:
+        return self.batch
+
+    def total_examples(self) -> int:
+        return len(self.sequences)

@@ -136,4 +136,4 @@ def test_losses_nonnegative_and_zero_at_target(seed, b, k):
     loss = float(get_loss("mcxent_with_logits")(y, logits))
     assert loss >= 0.0
     sharp = float(get_loss("mcxent_with_logits")(y, y * 50.0))
-    assert sharp < loss + 1e-6 or sharp < 1e-3
+    assert sharp < 1e-3  # near-perfect logits -> near-zero loss

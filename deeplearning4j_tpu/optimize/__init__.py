@@ -18,6 +18,7 @@ from deeplearning4j_tpu.optimize.api import (
     OptimizationAlgorithm,
     IterationListener,
     ComposableIterationListener,
+    NanGuardListener,
     ScoreIterationListener,
 )
 from deeplearning4j_tpu.optimize.line_search import backtrack_line_search
@@ -39,6 +40,7 @@ __all__ = [
     "OptimizationAlgorithm",
     "IterationListener",
     "ComposableIterationListener",
+    "NanGuardListener",
     "ScoreIterationListener",
     "backtrack_line_search",
     "stochastic_gradient_descent",

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import enum
 import logging
+import math
 from typing import Callable, Sequence
 
 log = logging.getLogger(__name__)
@@ -45,6 +46,22 @@ class ScoreIterationListener(IterationListener):
     def iteration_done(self, model, iteration: int, score: float) -> None:
         if iteration % self.print_iterations == 0:
             self._out(f"Score at iteration {iteration} is {score}")
+
+
+class NanGuardListener(IterationListener):
+    """Fails LOUDLY the moment the training score goes non-finite,
+    instead of silently training on garbage — the reference's defensive
+    `LinAlgExceptions.assertValidNum` guard (`MultiLayerNetwork.java:677`)
+    as an attachable listener.  Note: any registered listener forces a
+    host sync per step (the score must reach the host to be checked) —
+    the same cost the reference pays for its per-step assertion."""
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        if not math.isfinite(score):
+            raise FloatingPointError(
+                f"training score became {score} at iteration {iteration} "
+                f"— exploding/NaN loss; lower the learning rate, clip "
+                f"gradients, or inspect the input batch")
 
 
 class ComposableIterationListener(IterationListener):

@@ -154,3 +154,37 @@ class TestSolverDriver:
         assert after < before * 0.5
         acc = (net.predict(x) == labels).mean()
         assert acc > 0.9
+
+
+def test_nan_guard_listener_raises_on_nonfinite_score():
+    """NanGuardListener (reference assertValidNum parity): a diverging fit
+    must fail loudly at the first non-finite score, not keep training."""
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayerConf,
+        MultiLayerConfiguration,
+        NeuralNetConfiguration,
+        OutputLayerConf,
+    )
+    from deeplearning4j_tpu.optimize import NanGuardListener
+
+    conf = MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=1e30, updater="sgd"),
+        layers=(DenseLayerConf(n_in=4, n_out=8, activation="relu"),
+                OutputLayerConf(n_in=8, n_out=3)))
+    net = MultiLayerNetwork(conf).init()
+    net.add_listener(NanGuardListener())
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 4)).astype(np.float32) * 1e3
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    with pytest.raises(FloatingPointError, match="non|nan|inf"):
+        for _ in range(50):  # lr=1e30 must blow up within a few steps
+            net.fit_batch(x, y)
+
+    # sane training with the guard attached proceeds normally
+    ok = MultiLayerNetwork(MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=0.01, updater="adam"),
+        layers=(DenseLayerConf(n_in=4, n_out=8), OutputLayerConf(n_in=8, n_out=3)))).init()
+    ok.add_listener(NanGuardListener())
+    for _ in range(5):
+        ok.fit_batch(x / 1e3, y)

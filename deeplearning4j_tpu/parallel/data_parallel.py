@@ -56,17 +56,34 @@ class DataParallelTrainer:
     """Wraps a MultiLayerNetwork with an SPMD data-parallel train step."""
 
     def __init__(self, net: MultiLayerNetwork, mesh=None, axis: str = "data",
-                 sync_every: int = 1):
+                 sync_every: int = 1, shard_update: bool = False):
         self.net = net
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.axis = axis
         self.sync_every = sync_every
+        self.shard_update = shard_update
         self.n_devices = int(np.prod(self.mesh.devices.shape))
+        if shard_update and sync_every != 1:
+            raise ValueError("shard_update requires sync_every == 1 "
+                             "(sharded optimizer state cannot diverge "
+                             "per replica)")
         if net.params is None:
             net.init()
-        self._updater = make_updater(net.conf.conf.updater_config())
-        self._step_fn = (self._build_step() if sync_every == 1
-                         else self._build_local_step())
+        ucfg = net.conf.conf.updater_config()
+        if shard_update and (ucfg.clip_norm is not None or ucfg.unit_norm):
+            # These transforms need the WHOLE gradient tree (global norm /
+            # per-leaf norms); a 1/N flat shard would silently compute a
+            # different update than the replicated path.
+            raise ValueError(
+                "shard_update is incompatible with clip_norm/unit_norm "
+                "(non-elementwise gradient transforms); use the "
+                "replicated DP path for those configs")
+        self._updater = make_updater(ucfg)
+        if shard_update:
+            self._step_fn = self._build_sharded_update_step()
+        else:
+            self._step_fn = (self._build_step() if sync_every == 1
+                             else self._build_local_step())
         self._avg_fn = None
         self._rep = None  # stacked (params, state, upd_state), local mode
         self._iteration = 0
@@ -111,6 +128,110 @@ class DataParallelTrainer:
             check_rep=False,
         )
         return jax.jit(fn)
+
+    def _build_sharded_update_step(self):
+        """ZeRO-1-style cross-replica weight-update sharding (Xu et al.,
+        "Automatic Cross-Replica Sharding of Weight Update in
+        Data-Parallel Training", arXiv:2004.13336): gradients are
+        `psum_scatter`'d over the data axis so each replica holds only
+        its 1/N slice of the flat gradient, updates ITS slice of the
+        parameters and optimizer state (which lives sharded between
+        steps — the N-fold optimizer-memory saving), then `all_gather`s
+        the updated parameters for the next forward.  For elementwise
+        updaters (all of ours) the result is bit-equivalent to the
+        replicated update; it trades one reduce_scatter + one all_gather
+        for the pmean and divides update FLOPs and optimizer HBM by N."""
+        from jax.flatten_util import ravel_pytree
+
+        net = self.net
+        updater = self._updater
+        axis = self.axis
+        # Shard over the DATA axis only (a multi-axis mesh replicates the
+        # opt state over its other axes, same as the params).
+        n = int(self.mesh.shape[self.axis])
+        k0, unravel = self._flat_meta()
+        k = self._flat_k = ((k0 + n - 1) // n) * n  # padded flat length
+
+        def shard_step(params, state, upd_shard, x, y, rng, mask):
+            rng = jax.random.fold_in(rng, lax.axis_index(axis))
+
+            def lossfn(p):
+                return net._objective(p, state, x, y, rng, mask)
+
+            (loss, new_state), grads = jax.value_and_grad(
+                lossfn, has_aux=True)(params)
+            flat_g = ravel_pytree(grads)[0]
+            flat_g = jnp.pad(flat_g, (0, k - k0))
+            # mean-gradient SHARD: [k/n] per replica, not the full [k]
+            g_shard = lax.psum_scatter(flat_g, axis, tiled=True) / n
+            flat_p = jnp.pad(ravel_pytree(params)[0], (0, k - k0))
+            p_shard = lax.dynamic_slice_in_dim(
+                flat_p, lax.axis_index(axis) * (k // n), k // n)
+            updates, upd_shard = updater.update(
+                {"p": g_shard}, upd_shard, {"p": p_shard})
+            new_shard = apply_updates({"p": p_shard}, updates)["p"]
+            new_flat = lax.all_gather(new_shard, axis, tiled=True)[:k0]
+            params = unravel(new_flat)
+            loss = lax.pmean(loss, axis)
+            new_state = jax.tree_util.tree_map(
+                lambda s: lax.pmean(s, axis) if jnp.issubdtype(
+                    jnp.asarray(s).dtype, jnp.floating) else s,
+                new_state)
+            return params, new_state, upd_shard, loss
+
+        pspec = P()
+        dspec = P(self.axis)
+        # Optimizer-state leaves over the padded flat vector shard over
+        # the axis; scalar leaves (step counters) stay replicated.
+        self._opt_shard = self._init_sharded_opt_state()
+        sspec = jax.tree_util.tree_map(
+            lambda a: P(self.axis) if np.shape(a) == (k,) else P(),
+            self._opt_shard)
+        fn = shard_map(
+            shard_step,
+            mesh=self.mesh,
+            in_specs=(pspec, pspec, sspec, dspec, dspec, pspec, dspec),
+            out_specs=(pspec, pspec, sspec, pspec),
+            check_rep=False,
+        )
+        return jax.jit(fn)
+
+    def _flat_meta(self):
+        from jax.flatten_util import ravel_pytree
+
+        if not hasattr(self, "_flat_cache"):
+            flat, unravel = ravel_pytree(self.net.params)
+            self._flat_cache = (int(flat.shape[0]), unravel, flat)
+        k0, unravel, _ = self._flat_cache
+        return k0, unravel
+
+    def _init_sharded_opt_state(self):
+        """Optimizer state over the padded flat parameter vector, laid out
+        sharded over the data axis (each device holds 1/N).  If the
+        network already carries a matching flat-sharded state (e.g.
+        restored from a checkpoint of a previous shard_update run), adopt
+        it instead of re-initializing — resume keeps the moments."""
+        from jax.sharding import NamedSharding
+
+        k0, _ = self._flat_meta()
+        k = self._flat_k
+        flat = jnp.pad(self._flat_cache[2], (0, k - k0))
+        state = self._updater.init({"p": flat})
+        existing = self.net.updater_state
+        if existing is not None:
+            want = jax.tree_util.tree_structure(state)
+            have = jax.tree_util.tree_structure(existing)
+            shapes_match = want == have and all(
+                np.shape(a) == np.shape(b) for a, b in zip(
+                    jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(existing)))
+            if shapes_match:
+                state = existing
+        sh = NamedSharding(self.mesh, P(self.axis))
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(jnp.asarray(a), sh)
+            if np.ndim(a) == 1 and np.shape(a) == (k,) else jnp.asarray(a),
+            state)
 
     def _build_local_step(self):
         """Local-SGD step: each replica holds ITS OWN params slice (leading
@@ -187,7 +308,14 @@ class DataParallelTrainer:
         ys = mesh_lib.shard_batch(self.mesh, jnp.asarray(y), self.axis)
         ms = (None if mask is None
               else mesh_lib.shard_batch(self.mesh, jnp.asarray(mask), self.axis))
-        if self.sync_every == 1:
+        if self.shard_update:
+            net.params, net.state, self._opt_shard, loss = self._step_fn(
+                net.params, net.state, self._opt_shard, xs, ys, rng, ms)
+            # Keep the live sharded state visible on the net so the
+            # standard checkpoint pattern (save net.updater_state)
+            # captures trained moments, not the untouched init.
+            net.updater_state = self._opt_shard
+        elif self.sync_every == 1:
             net.params, net.state, net.updater_state, loss = self._step_fn(
                 net.params, net.state, net.updater_state, xs, ys, rng, ms)
         else:
@@ -253,9 +381,14 @@ class DataParallelTrainer:
             self._average_params()
 
     def scaling_report(self) -> dict:
+        if self.shard_update:
+            collective = "psum_scatter+all_gather (zero-1 weight update)"
+        elif self.sync_every == 1:
+            collective = "pmean"
+        else:
+            collective = f"param-average every {self.sync_every}"
         return {
             "devices": self.n_devices,
             "mesh": dict(zip(self.mesh.axis_names, self.mesh.devices.shape)),
-            "collective": "pmean" if self.sync_every == 1 else
-                          f"param-average every {self.sync_every}",
+            "collective": collective,
         }

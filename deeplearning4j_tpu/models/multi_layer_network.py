@@ -26,6 +26,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 
 from deeplearning4j_tpu.nn.conf import (
@@ -232,41 +233,105 @@ class MultiLayerNetwork:
 
     # ---- jitted steps -----------------------------------------------------
 
-    def _make_train_step(self):
+    def _make_train_step(self, accum: int = 1):
         updater = self._updater
 
         # donate the carried training state: params/opt-state buffers are
         # re-used in place instead of copied every step (HBM hygiene).
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, state, upd_state, x, y, rng, mask):
-            def lossfn(p):
-                return self._objective(p, state, x, y, rng, mask)
+            if accum == 1:
+                def lossfn(p):
+                    return self._objective(p, state, x, y, rng, mask)
 
-            (loss, new_state), grads = jax.value_and_grad(
-                lossfn, has_aux=True)(params)
+                (loss, new_state), grads = jax.value_and_grad(
+                    lossfn, has_aux=True)(params)
+            else:
+                # Gradient accumulation: the batch splits into `accum`
+                # microbatches scanned sequentially — activation memory
+                # of ONE microbatch, gradients averaged, ONE updater
+                # step.  The TPU way to train at batch sizes whose
+                # activations exceed HBM.
+                def micro(xy):
+                    return xy.reshape((accum, xy.shape[0] // accum)
+                                      + xy.shape[1:])
+
+                xs, ys = micro(x), micro(y)
+                keys = jax.random.split(rng, accum)
+                inputs = ((xs, ys, keys) if mask is None
+                          else (xs, ys, keys, micro(mask)))
+
+                def body(carry, inp):
+                    g_acc, state_c, loss_acc, w_acc = carry
+                    xi, yi, ki = inp[:3]
+                    mi = inp[3] if mask is not None else None
+
+                    def lossfn(p):
+                        return self._objective(p, state_c, xi, yi, ki, mi)
+
+                    (li, state_c), gi = jax.value_and_grad(
+                        lossfn, has_aux=True)(params)
+                    # Microbatches are weighted by their share of the
+                    # full batch's normalizer (valid mask tokens when a
+                    # mask is present, else uniform), so the accumulated
+                    # update EQUALS the full-batch update even when
+                    # microbatches carry different valid-token counts.
+                    # (same condition under which _masked_loss normalizes
+                    # by the mask sum)
+                    wi = (jnp.maximum(jnp.sum(mi), 1.0)
+                          if mi is not None and yi.ndim == 3
+                          else jnp.asarray(1.0))
+                    g_acc = jax.tree_util.tree_map(
+                        lambda a, g: a + wi * g, g_acc, gi)
+                    return (g_acc, state_c, loss_acc + wi * li,
+                            w_acc + wi), None
+
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+                (grads, new_state, loss, w_total), _ = lax.scan(
+                    body, (zeros, state, 0.0, 0.0), inputs)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / w_total, grads)
+                loss = loss / w_total
             updates, upd_state = updater.update(grads, upd_state, params)
             params = apply_updates(params, updates)
             return params, new_state, upd_state, loss
 
         return train_step
 
-    def fit_batch_async(self, x, y, mask=None) -> jax.Array:
+    def fit_batch_async(self, x, y, mask=None, accum_steps: int = 1
+                        ) -> jax.Array:
         """One SGD step; returns the loss as a DEVICE array without
         synchronizing, so back-to-back steps pipeline on the chip.
         Listeners (which need a host float) force a sync only when
-        registered."""
+        registered.  accum_steps > 1 splits the batch into that many
+        sequential microbatches (gradient accumulation): same update as
+        the full batch for mean losses, activation memory of one
+        microbatch."""
         if self.params is None:
             self.init()
+        if self.updater_state is None:
+            # A sharded-update trainer owned the optimizer state (see
+            # DataParallelTrainer.finalize); direct training restarts
+            # with fresh moments.
+            self.updater_state = self._updater.init(self.params)
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        if accum_steps > 1 and jnp.shape(x)[0] % accum_steps:
+            raise ValueError(f"batch {jnp.shape(x)[0]} not divisible by "
+                             f"accum_steps {accum_steps}")
         if self._jit_train_step is None:
-            self._jit_train_step = self._make_train_step()
+            self._jit_train_step = {}
+        step = self._jit_train_step.get(accum_steps)
+        if step is None:
+            step = self._jit_train_step[accum_steps] = \
+                self._make_train_step(accum_steps)
         rng = jax.random.fold_in(
             jax.random.PRNGKey(self.conf.conf.seed), self._iteration)
         x = jnp.asarray(x)
         y = jnp.asarray(y)
         mask = None if mask is None else jnp.asarray(mask)
-        self.params, self.state, self.updater_state, loss = (
-            self._jit_train_step(self.params, self.state, self.updater_state,
-                                 x, y, rng, mask))
+        self.params, self.state, self.updater_state, loss = step(
+            self.params, self.state, self.updater_state, x, y, rng, mask)
         self._iteration += 1
         if self._listeners:
             loss_f = float(loss)
@@ -274,10 +339,10 @@ class MultiLayerNetwork:
                 listener(self._iteration, loss_f)
         return loss
 
-    def fit_batch(self, x, y, mask=None) -> float:
+    def fit_batch(self, x, y, mask=None, accum_steps: int = 1) -> float:
         """One SGD step on one minibatch (reference fit(INDArray,INDArray)
         :1244). Returns the loss."""
-        return float(self.fit_batch_async(x, y, mask))
+        return float(self.fit_batch_async(x, y, mask, accum_steps))
 
     def fit(self, data, epochs: int = 1) -> "MultiLayerNetwork":
         """Train from a DataSetIterator-like iterable (yielding objects with

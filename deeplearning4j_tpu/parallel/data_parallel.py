@@ -201,37 +201,69 @@ class DataParallelTrainer:
 
         if not hasattr(self, "_flat_cache"):
             flat, unravel = ravel_pytree(self.net.params)
-            self._flat_cache = (int(flat.shape[0]), unravel, flat)
-        k0, unravel, _ = self._flat_cache
-        return k0, unravel
+            self._flat_cache = (int(flat.shape[0]), unravel)
+        return self._flat_cache
+
+    @staticmethod
+    def _is_p_dict(node):
+        return isinstance(node, dict) and set(node) == {"p"}
 
     def _init_sharded_opt_state(self):
         """Optimizer state over the padded flat parameter vector, laid out
-        sharded over the data axis (each device holds 1/N).  If the
-        network already carries a matching flat-sharded state (e.g.
-        restored from a checkpoint of a previous shard_update run), adopt
-        it instead of re-initializing — resume keeps the moments."""
+        sharded over the data axis (each device holds 1/N of every flat
+        moment).
+
+        If `net.updater_state` holds a per-layer state with trained
+        moments (the form `finalize()` publishes and checkpoints save —
+        device-count independent), ADOPT it by raveling each moment tree
+        into the flat layout, so resume keeps the moments even on a
+        different mesh size."""
+        from jax.flatten_util import ravel_pytree
         from jax.sharding import NamedSharding
 
         k0, _ = self._flat_meta()
-        k = self._flat_k
-        flat = jnp.pad(self._flat_cache[2], (0, k - k0))
-        state = self._updater.init({"p": flat})
+        n = int(self.mesh.shape[self.axis])
+        k = self._flat_k = ((k0 + n - 1) // n) * n
+        flat0 = jnp.pad(ravel_pytree(self.net.params)[0], (0, k - k0))
+        state = self._updater.init({"p": flat0})
         existing = self.net.updater_state
-        if existing is not None:
-            want = jax.tree_util.tree_structure(state)
-            have = jax.tree_util.tree_structure(existing)
-            shapes_match = want == have and all(
-                np.shape(a) == np.shape(b) for a, b in zip(
-                    jax.tree_util.tree_leaves(state),
-                    jax.tree_util.tree_leaves(existing)))
-            if shapes_match:
-                state = existing
+        template = self._updater.init(self.net.params)
+        if existing is not None and (
+                jax.tree_util.tree_structure(existing)
+                == jax.tree_util.tree_structure(template)):
+            # per-layer moments -> padded flat moments, position-matched
+            # against the flat template via the single-key {"p": .} dicts
+            # init({"p": flat}) wraps every moment tree in.
+            def adopt(flat_node, layer_node):
+                if self._is_p_dict(flat_node):
+                    vec = ravel_pytree(layer_node)[0]
+                    return {"p": jnp.pad(vec, (0, k - vec.shape[0]))}
+                return jnp.asarray(layer_node)  # scalar leaves (step)
+
+            state = jax.tree_util.tree_map(
+                adopt, state, existing, is_leaf=self._is_p_dict)
         sh = NamedSharding(self.mesh, P(self.axis))
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(jnp.asarray(a), sh)
             if np.ndim(a) == 1 and np.shape(a) == (k,) else jnp.asarray(a),
             state)
+
+    def sync_updater_state_to_net(self) -> None:
+        """Publish the sharded optimizer state back to `net.updater_state`
+        in the net's own per-layer form (device-count independent) — what
+        checkpoints should save.  Called by `finalize()`; cheap enough to
+        call at any checkpoint boundary, too expensive for every step."""
+        if not self.shard_update:
+            return
+        k0, unravel = self._flat_meta()
+
+        def publish(node):
+            if self._is_p_dict(node):
+                return unravel(jnp.asarray(node["p"])[:k0])
+            return node
+
+        self.net.updater_state = jax.tree_util.tree_map(
+            publish, self._opt_shard, is_leaf=self._is_p_dict)
 
     def _build_local_step(self):
         """Local-SGD step: each replica holds ITS OWN params slice (leading
@@ -311,10 +343,13 @@ class DataParallelTrainer:
         if self.shard_update:
             net.params, net.state, self._opt_shard, loss = self._step_fn(
                 net.params, net.state, self._opt_shard, xs, ys, rng, ms)
-            # Keep the live sharded state visible on the net so the
-            # standard checkpoint pattern (save net.updater_state)
-            # captures trained moments, not the untouched init.
-            net.updater_state = self._opt_shard
+            # The TRAINER owns the (sharded) optimizer state while this
+            # mode runs; clearing the net's copy means a stale-zeros
+            # checkpoint is impossible (savers fail loudly on None) and
+            # direct net.fit_batch restarts with fresh moments instead
+            # of a structure-mismatch crash.  finalize() publishes the
+            # per-layer form back.
+            net.updater_state = None
         elif self.sync_every == 1:
             net.params, net.state, net.updater_state, loss = self._step_fn(
                 net.params, net.state, net.updater_state, xs, ys, rng, ms)
@@ -375,10 +410,14 @@ class DataParallelTrainer:
         self.net.updater_state = unstack(u)
 
     def finalize(self) -> None:
-        """Average any outstanding per-replica drift into net.params
-        (local-SGD mode; no-op for the synchronous path)."""
+        """Publish trainer-held state back to the net: averages any
+        outstanding per-replica drift (local-SGD mode) and converts the
+        sharded optimizer state to the net's per-layer form
+        (shard_update mode).  Call before checkpointing or handing the
+        net to other training paths; no-op for the plain sync path."""
         if self.sync_every > 1 and self._rep is not None:
             self._average_params()
+        self.sync_updater_state_to_net()
 
     def scaling_report(self) -> dict:
         if self.shard_update:

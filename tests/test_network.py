@@ -229,3 +229,29 @@ class TestEvaluation:
         assert ev.recall(0) == 0.5
         assert ev.confusion.count(0, 1) == 1
         assert "Accuracy" in ev.stats()
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps=k must produce the same update as the full batch (mean
+    losses: grad of the mean == mean of microbatch grads), with only a
+    microbatch of activations live at once."""
+    import dataclasses
+
+    from deeplearning4j_tpu.models import iris_mlp
+
+    conf = iris_mlp(updater="sgd")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((24, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 24)]
+
+    def train(accum):
+        net = MultiLayerNetwork(conf).init()
+        losses = [net.fit_batch(x, y, accum_steps=accum) for _ in range(4)]
+        return net.params_flat(), losses
+
+    p1, l1 = train(1)
+    p4, l4 = train(4)
+    np.testing.assert_allclose(l4, l1, rtol=1e-5)
+    np.testing.assert_allclose(p4, p1, atol=2e-6)
+    with pytest.raises(ValueError, match="divisible"):
+        MultiLayerNetwork(conf).init().fit_batch(x, y, accum_steps=5)

@@ -278,7 +278,7 @@ class MultiLayerNetwork:
                     # microbatches carry different valid-token counts.
                     # (same condition under which _masked_loss normalizes
                     # by the mask sum)
-                    wi = (jnp.maximum(jnp.sum(mi), 1.0)
+                    wi = (jnp.sum(mi)
                           if mi is not None and yi.ndim == 3
                           else jnp.asarray(1.0))
                     g_acc = jax.tree_util.tree_map(
@@ -289,6 +289,7 @@ class MultiLayerNetwork:
                 zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
                 (grads, new_state, loss, w_total), _ = lax.scan(
                     body, (zeros, state, 0.0, 0.0), inputs)
+                w_total = jnp.maximum(w_total, 1e-8)  # all-pad batch
                 grads = jax.tree_util.tree_map(
                     lambda g: g / w_total, grads)
                 loss = loss / w_total

@@ -76,7 +76,8 @@ class ParagraphVectors(Word2Vec):
         total = max(len(arr) * self.epochs, 1)
         seen = 0
         for epoch in range(self.epochs):
-            rng.shuffle(arr)
+            arr = arr[rng.permutation(len(arr))]  # see _make_pairs: 2-D
+            # rng.shuffle is per-row swaps, ~40x slower
             for s in range(0, len(arr), B):
                 chunk = arr[s:s + B]
                 n_real = len(chunk)

@@ -227,10 +227,10 @@ class DataParallelTrainer:
         flat0 = jnp.pad(ravel_pytree(self.net.params)[0], (0, k - k0))
         state = self._updater.init({"p": flat0})
         existing = self.net.updater_state
-        template = self._updater.init(self.net.params)
         if existing is not None and (
                 jax.tree_util.tree_structure(existing)
-                == jax.tree_util.tree_structure(template)):
+                == jax.tree_util.tree_structure(
+                    self._updater.init(self.net.params))):
             # per-layer moments -> padded flat moments, position-matched
             # against the flat template via the single-key {"p": .} dicts
             # init({"p": flat}) wraps every moment tree in.
@@ -344,12 +344,18 @@ class DataParallelTrainer:
             net.params, net.state, self._opt_shard, loss = self._step_fn(
                 net.params, net.state, self._opt_shard, xs, ys, rng, ms)
             # The TRAINER owns the (sharded) optimizer state while this
-            # mode runs; clearing the net's copy means a stale-zeros
-            # checkpoint is impossible (savers fail loudly on None) and
+            # mode runs.  With listeners registered (e.g. a periodic
+            # CheckpointListener — they force a host sync anyway) the
+            # per-layer form is published every step so mid-run
+            # checkpoints keep trained moments; otherwise the net's copy
+            # is cleared, so a checkpoint taken without finalize() skips
+            # the state rather than silently saving stale zeros, and
             # direct net.fit_batch restarts with fresh moments instead
-            # of a structure-mismatch crash.  finalize() publishes the
-            # per-layer form back.
-            net.updater_state = None
+            # of a structure-mismatch crash.
+            if net._listeners:
+                self.sync_updater_state_to_net()
+            else:
+                net.updater_state = None
         elif self.sync_every == 1:
             net.params, net.state, net.updater_state, loss = self._step_fn(
                 net.params, net.state, net.updater_state, xs, ys, rng, ms)
@@ -378,8 +384,7 @@ class DataParallelTrainer:
             for x, y, mask in _as_batches(data):
                 self.fit_batch(x, y, mask)
             _maybe_reset(data)
-        if self.sync_every > 1:
-            self.finalize()
+        self.finalize()  # publish trainer-held state back to the net
         return self
 
     def _average_params(self) -> None:

@@ -26,7 +26,8 @@ from deeplearning4j_tpu.ops.updaters import (
     make_updater,
 )
 
-SETTINGS = settings(max_examples=15, deadline=None)
+SETTINGS = settings(max_examples=15, deadline=None,
+                    derandomize=True)  # stable across CI runs
 
 ACTIVATIONS = st.sampled_from(["relu", "tanh", "sigmoid", "elu", "gelu"])
 UPDATERS = st.sampled_from([u.value for u in Updater if u != Updater.NONE])

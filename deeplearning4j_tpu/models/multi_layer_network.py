@@ -541,6 +541,27 @@ class MultiLayerNetwork:
             raise ValueError(
                 f"Parameter vector length {vec.size} != model size {offset}")
 
+    def summary(self) -> str:
+        """Human-readable layer table (layer type, shapes, parameter
+        counts) — the quick sanity check every framework user reaches
+        for before training.  NOTE: initializes the network if needed
+        (parameter counts come from the real shapes)."""
+        if self.params is None:
+            self.init()
+        w = max([len(type(lc).__name__) for lc in self.conf.layers]
+                + [len("type")])
+        lines = [f"{'#':>3}  {'type':<{w}} {'in->out':<14} {'params':>10}"]
+        total = 0
+        for i, (lc, p) in enumerate(zip(self.conf.layers, self.params)):
+            n = int(sum(np.prod(np.shape(a)) for a in p.values()))
+            total += n
+            shape = ("-" if lc.n_in is None
+                     else f"{lc.n_in}->{lc.n_out if lc.n_out is not None else lc.n_in}")
+            lines.append(f"{i:>3}  {type(lc).__name__:<{w}} {shape:<14} "
+                         f"{n:>10,}")
+        lines.append(f"{'':>3}  {'TOTAL':<{w}} {'':<14} {total:>10,}")
+        return "\n".join(lines)
+
     def merge(self, others: Sequence["MultiLayerNetwork"]) -> None:
         """Parameter averaging across replicas (reference merge() :1499) —
         kept for API parity/A-B tests; the TPU-native path is psum-based DP

@@ -255,3 +255,13 @@ def test_gradient_accumulation_matches_full_batch():
     np.testing.assert_allclose(p4, p1, atol=2e-6)
     with pytest.raises(ValueError, match="divisible"):
         MultiLayerNetwork(conf).init().fit_batch(x, y, accum_steps=5)
+
+
+def test_summary_lists_layers_and_total():
+    from deeplearning4j_tpu.models import get_model
+
+    net = MultiLayerNetwork(get_model("lenet-mnist")).init()
+    s = net.summary()
+    assert "ConvolutionLayerConf" in s and "OutputLayerConf" in s
+    assert f"{net.num_params():,}" in s
+    assert len(s.splitlines()) == len(net.conf.layers) + 2

@@ -7,6 +7,7 @@ tracking, and the Akka/YARN job control (SURVEY §2.3, §5).
 """
 
 from deeplearning4j_tpu.runtime.checkpoint import (
+    AsyncCheckpointListener,
     CheckpointListener,
     DiskModelSaver,
     ModelSaver,
@@ -40,6 +41,7 @@ __all__ = [
     "load_checkpoint",
     "ModelSaver",
     "DiskModelSaver",
+    "AsyncCheckpointListener",
     "CheckpointListener",
     "get_store",
     "save_checkpoint_remote",

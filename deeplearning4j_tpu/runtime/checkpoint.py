@@ -26,6 +26,7 @@ import pathlib
 import re
 import tempfile
 import time
+import warnings
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -272,3 +273,105 @@ class CheckpointListener:
         save_checkpoint(self.directory, iteration, model.params,
                         updater_state=upd, extra={"score": float(score)},
                         keep=self.keep)
+
+
+class AsyncCheckpointListener(CheckpointListener):
+    """CheckpointListener that does NOT block the training loop on IO.
+
+    At each trigger it snapshots the pytrees with on-device copies
+    (`Array.copy()` — async-dispatched device work, required because the
+    jitted step DONATES its input buffers: by the time a background
+    thread would read them, the originals are deleted), then a single
+    worker thread device_gets and writes the snapshot while the chip
+    trains on.  At most one snapshot is live (queued OR being written);
+    a trigger arriving while one is in flight is skipped with a warning
+    rather than stacking HBM snapshots.  Call `close()` (or use as a
+    context manager) to flush the last write; a closed listener raises
+    if it keeps receiving iterations.
+
+    Single-host only: `save_checkpoint`'s multi-host barriers cannot run
+    on a background thread (hosts could disagree on skips and deadlock
+    the collective) — multi-host jobs use the synchronous listener.
+    """
+
+    def __init__(self, directory: os.PathLike, every: int = 100,
+                 keep: int = 3, save_updater: bool = True):
+        import queue
+        import threading
+
+        super().__init__(directory, every=every, keep=keep,
+                         save_updater=save_updater)
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "AsyncCheckpointListener is single-host (background-"
+                "thread barriers would deadlock); use CheckpointListener "
+                "in multi-host jobs")
+        self._queue = queue.Queue(maxsize=1)
+        self._queue_full = queue.Full
+        self._closed = False
+        self._errors: list = []
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return
+                step, params, upd, score = item
+                save_checkpoint(self.directory, step, params,
+                                updater_state=upd,
+                                extra={"score": score}, keep=self.keep)
+            except Exception as e:  # noqa: BLE001 — surfaced on next call
+                self._errors.append(e)
+            finally:
+                # unfinished_tasks is the in-flight indicator: it counts
+                # queued AND currently-writing snapshots.
+                self._queue.task_done()
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        if self._errors:
+            raise RuntimeError(
+                "async checkpoint write failed") from self._errors.pop(0)
+        if self._closed:
+            raise RuntimeError(
+                "AsyncCheckpointListener is closed — unregister it from "
+                "the model or create a new one")
+        if iteration % self.every != 0:
+            return
+        score = float(score)
+        if self._queue.unfinished_tasks > 0:
+            # Check BEFORE snapshotting: a skip must not pay for (and
+            # momentarily hold) a full device copy.
+            warnings.warn(
+                f"async checkpoint at iteration {iteration} skipped: "
+                f"previous write still in flight (raise `every`?)",
+                stacklevel=2)
+            return
+
+        def snap(tree):
+            return jax.tree_util.tree_map(
+                lambda a: a.copy() if isinstance(a, jax.Array) else a,
+                tree)
+
+        upd = (snap(getattr(model, "updater_state", None))
+               if self.save_updater else None)
+        self._queue.put((iteration, snap(model.params), upd, score))
+
+    def close(self) -> None:
+        """Flush pending writes and stop the worker (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._thread.join()
+        if self._errors:
+            raise RuntimeError(
+                "async checkpoint write failed") from self._errors.pop(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

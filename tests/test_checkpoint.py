@@ -114,3 +114,80 @@ class TestCheckpointListener:
         step, params, upd, extra = load_checkpoint(
             tmp_path, net.params, net.updater_state)
         assert "score" in extra
+
+
+class TestAsyncCheckpointListener:
+    def test_nonblocking_checkpoints_match_trigger_state(self, tmp_path):
+        """The async writer must snapshot BEFORE the next donated step
+        reuses the buffers: the checkpoint written for iteration N equals
+        the params exactly as they were after step N, even though
+        training continued while the write was in flight."""
+        import numpy as np
+
+        from deeplearning4j_tpu.models import MultiLayerNetwork, iris_mlp
+        from deeplearning4j_tpu.runtime import AsyncCheckpointListener
+        from deeplearning4j_tpu.runtime.checkpoint import (
+            latest_checkpoint,
+            load_checkpoint,
+        )
+
+        net = MultiLayerNetwork(iris_mlp(updater="adam")).init()
+        recorded = {}
+        net.add_listener(lambda it, score:
+                         recorded.__setitem__(it, net.params_flat()))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        with AsyncCheckpointListener(tmp_path, every=4) as ckpt:
+            net.add_listener(ckpt)
+            for _ in range(10):
+                net.fit_batch(x, y)
+        assert latest_checkpoint(tmp_path) is not None
+        step, params, upd, _extra = load_checkpoint(tmp_path, net.params,
+                                                    net.updater_state)
+        from jax.flatten_util import ravel_pytree
+
+        got = np.asarray(ravel_pytree(params)[0])
+        np.testing.assert_allclose(got, recorded[step], atol=0)
+        assert upd is not None  # moments came along
+
+    def test_worker_error_surfaces(self, tmp_path, monkeypatch):
+        import numpy as np
+        import pytest as _p
+
+        from deeplearning4j_tpu.models import MultiLayerNetwork, iris_mlp
+        from deeplearning4j_tpu.runtime import AsyncCheckpointListener
+        from deeplearning4j_tpu.runtime import checkpoint as ck
+
+        def boom(*a, **k):
+            raise OSError("disk on fire")
+
+        monkeypatch.setattr(ck, "save_checkpoint", boom)
+        net = MultiLayerNetwork(iris_mlp()).init()
+        listener = AsyncCheckpointListener(tmp_path, every=1)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        net.add_listener(listener)
+        with _p.raises(RuntimeError, match="async checkpoint"):
+            for _ in range(50):
+                net.fit_batch(x, y)
+
+    def test_closed_listener_raises_not_silently_drops(self, tmp_path):
+        import numpy as np
+        import pytest as _p
+
+        from deeplearning4j_tpu.models import MultiLayerNetwork, iris_mlp
+        from deeplearning4j_tpu.runtime import AsyncCheckpointListener
+
+        net = MultiLayerNetwork(iris_mlp()).init()
+        listener = AsyncCheckpointListener(tmp_path, every=1)
+        net.add_listener(listener)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        net.fit_batch(x, y)
+        listener.close()
+        listener.close()  # idempotent
+        with _p.raises(RuntimeError, match="closed"):
+            net.fit_batch(x, y)

@@ -214,14 +214,41 @@ def cmd_lm(args) -> int:
             return jax.tree_util.tree_map(
                 lambda w, g: w - args.lr * g, p, grads), loss
 
+        spmd_mesh = None
+        if args.runtime == "spmd":
+            # Data parallelism by GSPMD: the batch arrives sharded over
+            # the mesh's data axis, params stay replicated, and XLA
+            # inserts the gradient allreduce — no code change to `step`.
+            from deeplearning4j_tpu.parallel import make_mesh
+            from deeplearning4j_tpu.parallel.mesh import (
+                round_batch_to_mesh,
+                shard_batch,
+            )
+
+            spmd_mesh = make_mesh()  # 1-D 'data' mesh over all devices
+            n = spmd_mesh.devices.size
+            if n == 1:
+                print("spmd: only 1 device visible — equivalent to local")
+            rounded = round_batch_to_mesh(B, spmd_mesh)
+            if rounded != B:
+                print(f"spmd: -batch {B} rounded up to {rounded} "
+                      f"({n}-device shards; `dl4j train` pads likewise)")
+                B = rounded
+
         rng = np.random.default_rng(0)
         steps = max(1, args.epochs * (len(ids) // max(B * S, 1)))
         t0, loss = time.time(), None
         for k in range(steps):
             starts = rng.integers(0, len(ids) - S - 1, B)
-            tokens = jnp.asarray(np.stack([ids[s:s + S] for s in starts]))
-            targets = jnp.asarray(
-                np.stack([ids[s + 1:s + S + 1] for s in starts]))
+            tokens = np.stack([ids[s:s + S] for s in starts])
+            targets = np.stack([ids[s + 1:s + S + 1] for s in starts])
+            if spmd_mesh is not None:
+                # one sharded host transfer, not asarray + reshard
+                tokens, targets = shard_batch(spmd_mesh, (tokens, targets))
+                if k == 0:
+                    print(f"spmd: batch sharded over {n} devices")
+            else:
+                tokens, targets = jnp.asarray(tokens), jnp.asarray(targets)
             params, loss = step(params, tokens, targets)
             if args.verbose and (k + 1) % 20 == 0:
                 print(f"step {k + 1}/{steps} loss {float(loss):.4f}")
@@ -333,6 +360,9 @@ def build_parser() -> argparse.ArgumentParser:
                       default=1.0, help="nucleus sampling mass")
     p_lm.add_argument("-gen-seed", "--gen-seed", dest="gen_seed", type=int,
                       default=0)
+    p_lm.add_argument("-runtime", "--runtime",
+                      choices=["local", "spmd"], default="local",
+                      help="spmd = data-parallel over all devices (GSPMD)")
     p_lm.add_argument("-verbose", "--verbose", action="store_true")
     p_lm.set_defaults(fn=cmd_lm)
 

@@ -137,3 +137,22 @@ def test_lm_train_save_generate(tmp_path, capsys):
     assert rc == 0
     sampled = capsys.readouterr().out
     assert sampled.startswith("the quick") and len(sampled) > len("the quick")
+
+
+def test_lm_spmd_runtime_trains_data_parallel(tmp_path, capsys):
+    """`dl4j lm -runtime spmd`: the batch shards over the 8-device mesh
+    (GSPMD inserts the gradient allreduce); training completes and the
+    saved LM generates."""
+    text = tmp_path / "c.txt"
+    text.write_text("abcdefgh " * 300)
+    out = tmp_path / "lm"
+    rc = main(["lm", "-input", str(text), "-output", str(out),
+               "-epochs", "1", "-batch", "8", "-seq", "16",
+               "-d-model", "16", "-layers", "1", "-heads", "2",
+               "-runtime", "spmd"])
+    assert rc == 0
+    stdout = capsys.readouterr().out
+    assert "spmd: batch sharded over 8 devices" in stdout
+    rc = main(["lm", "-output", str(out), "-generate", "abc",
+               "-max-new", "4", "-temperature", "0"])
+    assert rc == 0

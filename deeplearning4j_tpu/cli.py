@@ -204,7 +204,9 @@ def cmd_lm(args) -> int:
             raise SystemExit(f"input too short for -seq {S}")
         cfg = tfm.TransformerConfig(
             vocab_size=256, d_model=args.d_model, n_heads=args.heads,
-            n_layers=args.layers, d_ff=4 * args.d_model, max_len=S)
+            n_layers=args.layers, d_ff=4 * args.d_model, max_len=S,
+            dtype=("bfloat16" if jax.default_backend() == "tpu"
+                   else "float32"))  # MXU-native rate on TPU
         params = tfm.init_params(cfg, jax.random.PRNGKey(0))
 
         @jax.jit

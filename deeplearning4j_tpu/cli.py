@@ -202,17 +202,32 @@ def cmd_lm(args) -> int:
         S, B = args.seq, args.batch
         if len(ids) < S + 2:
             raise SystemExit(f"input too short for -seq {S}")
+        import dataclasses
+
+        # Mixed precision, not pure bf16: params/updates stay float32
+        # (a bf16 `w - lr*g` swallows updates below ~0.4% of the weight
+        # and training silently stalls); the forward casts to bf16 on
+        # TPU so the MXU runs at its native rate.
+        on_tpu = jax.default_backend() == "tpu"
         cfg = tfm.TransformerConfig(
             vocab_size=256, d_model=args.d_model, n_heads=args.heads,
-            n_layers=args.layers, d_ff=4 * args.d_model, max_len=S,
-            dtype=("bfloat16" if jax.default_backend() == "tpu"
-                   else "float32"))  # MXU-native rate on TPU
+            n_layers=args.layers, d_ff=4 * args.d_model, max_len=S)
         params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        compute_cfg = (dataclasses.replace(cfg, dtype="bfloat16")
+                       if on_tpu else cfg)
+
+        def _cast(tree, dt):
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(dt)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
 
         @jax.jit
         def step(p, tokens, targets):
-            loss, grads = jax.value_and_grad(
-                lambda q: tfm.lm_loss(cfg, q, tokens, targets))(p)
+            def loss_fn(q):
+                qc = (_cast(q, jnp.bfloat16) if on_tpu else q)
+                return tfm.lm_loss(compute_cfg, qc, tokens, targets)
+
+            loss, grads = jax.value_and_grad(loss_fn)(p)
             return jax.tree_util.tree_map(
                 lambda w, g: w - args.lr * g, p, grads), loss
 

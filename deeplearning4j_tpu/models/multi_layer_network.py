@@ -496,11 +496,24 @@ class MultiLayerNetwork:
             self.params, self.state, jnp.asarray(x), jnp.asarray(y),
             None if mask is None else jnp.asarray(mask)))
 
-    def evaluate(self, x, y, mask=None):
+    def evaluate(self, x, y, mask=None, batch_size: Optional[int] = None):
+        """Classification metrics over a dataset.  `batch_size` evaluates
+        in chunks (constant device memory on large test sets); the
+        confusion counts accumulate identically either way."""
         from deeplearning4j_tpu.evaluation import Evaluation
 
         ev = Evaluation()
-        ev.eval(np.asarray(y), np.asarray(self.output(x, mask)))
+        if batch_size is not None and batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        if batch_size is None:
+            ev.eval(np.asarray(y), np.asarray(self.output(x, mask)))
+            return ev
+        x = np.asarray(x)
+        y = np.asarray(y)
+        for i in range(0, len(x), batch_size):
+            m = None if mask is None else mask[i:i + batch_size]
+            ev.eval(y[i:i + batch_size],
+                    np.asarray(self.output(x[i:i + batch_size], m)))
         return ev
 
     # ---- parameter vector view (checkpoint/shipping format) ----------------

@@ -1,0 +1,16 @@
+"""Every example script must at least byte-compile — cheap drift guard
+(full runs live in the examples themselves; they are exercised manually
+and in round verification)."""
+
+import pathlib
+import py_compile
+
+import pytest
+
+EXAMPLES = sorted(
+    (pathlib.Path(__file__).resolve().parent.parent / "examples").glob("*.py"))
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.name)
+def test_example_compiles(path):
+    py_compile.compile(str(path), doraise=True)

@@ -265,3 +265,16 @@ def test_summary_lists_layers_and_total():
     assert "ConvolutionLayerConf" in s and "OutputLayerConf" in s
     assert f"{net.num_params():,}" in s
     assert len(s.splitlines()) == len(net.conf.layers) + 2
+
+
+def test_batched_evaluate_matches_full():
+    from deeplearning4j_tpu.datasets.fetchers import iris_dataset
+    from deeplearning4j_tpu.models import iris_mlp
+
+    ds = iris_dataset()
+    net = MultiLayerNetwork(iris_mlp()).init()
+    net.fit((np.asarray(ds.features), np.asarray(ds.labels)), epochs=10)
+    full = net.evaluate(ds.features, ds.labels)
+    chunked = net.evaluate(ds.features, ds.labels, batch_size=40)  # ragged tail
+    assert chunked.accuracy() == full.accuracy()
+    assert chunked.stats() == full.stats()

@@ -40,6 +40,20 @@ def _sgd_tree(params, grads, lr):
     return jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
 
 
+def _cast_floating(tree, dtype):
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+
+def _master_f32(tree):
+    """Float32 master copies: with a bf16 compute dtype, params must NOT
+    live (or update) in bf16 — `w - lr*g` in bf16 rounds away updates
+    below ~0.4% of the weight and training silently stalls.  Matches
+    MultiLayerNetwork's compute_dtype policy."""
+    return _cast_floating(tree, jnp.float32)
+
+
 def place_params(mesh: Mesh, tree, spec_tree):
     """device_put a pytree with a matching pytree of PartitionSpecs
     (PartitionSpec is itself a tuple, so flatten the spec tree with specs
@@ -65,14 +79,18 @@ class HybridParallelTrainer:
         self.axes = axes
         self._pspecs = tfm.param_specs(cfg, axes.model)
         self.params = place_params(
-            mesh, tfm.init_params(cfg, jax.random.PRNGKey(seed)),
+            mesh, _master_f32(tfm.init_params(cfg, jax.random.PRNGKey(seed))),
             self._pspecs)
         cfg_, lr_, mesh_, axes_ = cfg, lr, mesh, axes
+        compute_dtype = jnp.dtype(cfg.dtype)
 
         def step(params, tokens, targets):
-            loss, grads = jax.value_and_grad(
-                lambda p: tfm.lm_loss(cfg_, p, tokens, targets, mesh_,
-                                      axes_))(params)
+            def loss_fn(p):
+                pc = (p if compute_dtype == jnp.float32
+                      else _cast_floating(p, compute_dtype))
+                return tfm.lm_loss(cfg_, pc, tokens, targets, mesh_, axes_)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
             return _sgd_tree(params, grads, lr_), loss
 
         self._step = jax.jit(step, donate_argnums=(0,))
@@ -105,7 +123,7 @@ class PipelineParallelTrainer:
         self.layers_per_stage = cfg.n_layers // n_stages
         self.n_stages = n_stages
 
-        full = tfm.init_params(cfg, jax.random.PRNGKey(seed))
+        full = _master_f32(tfm.init_params(cfg, jax.random.PRNGKey(seed)))
         # stack per-layer trees: leaves [n_layers, ...] regrouped to
         # [n_stages, layers_per_stage, ...]; stage dim sharded over `stage`.
         stacked = jax.tree_util.tree_map(
@@ -136,12 +154,16 @@ class PipelineParallelTrainer:
         lr, m = self.lr, self.m
         data_axis, stage_axis = self.axes
         stage_fn = self._stage_fn
+        compute_dtype = jnp.dtype(self.cfg.dtype)
 
         def step(stage_params, io_params, tokens, targets):
             n_stages = lax.psum(1, stage_axis)
             is_last = lax.axis_index(stage_axis) == n_stages - 1
 
             def loss_fn(sp, iop):
+                if compute_dtype != jnp.float32:  # f32 masters, bf16 math
+                    sp = _cast_floating(sp, compute_dtype)
+                    iop = _cast_floating(iop, compute_dtype)
                 x = iop["embed"][tokens]
                 s = tokens.shape[1]
                 x = x + iop["pos"][None, :s, :]

@@ -225,3 +225,45 @@ class TestPipelineParallelTrainer:
         want_w1 = np.stack([np.asarray(l["mlp"]["w1"])
                             for l in ref_params["layers"]])
         np.testing.assert_allclose(got_w1, want_w1, atol=5e-4)
+
+
+def test_bf16_compute_keeps_f32_master_params():
+    """Mixed-precision contract for the hybrid trainers: with a bf16
+    config the parameters live (and update) in float32 — a pure-bf16
+    `w - lr*g` rounds away small updates and training silently stalls."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.parallel import make_mesh
+    from deeplearning4j_tpu.parallel import transformer as tfm
+    from deeplearning4j_tpu.parallel.hybrid import (
+        HybridParallelTrainer,
+        PipelineParallelTrainer,
+    )
+
+    rng = np.random.default_rng(0)
+    cfg = tfm.TransformerConfig(vocab_size=32, d_model=16, n_heads=4,
+                                n_layers=2, d_ff=32, max_len=16,
+                                dtype="bfloat16")
+    mesh3 = make_mesh((2, 1, 1), ("data", "seq", "model"),
+                      devices=jax.devices()[:2])
+    tr = HybridParallelTrainer(cfg, mesh3, lr=0.05)
+    toks = rng.integers(0, 32, (4, 8))
+    before = jax.tree_util.tree_leaves(tr.params)[0]
+    assert before.dtype == jnp.float32
+    loss = tr.fit_batch(toks, rng.integers(0, 32, (4, 8)))
+    assert np.isfinite(loss)
+    assert all(a.dtype == jnp.float32 or not jnp.issubdtype(
+        a.dtype, jnp.floating)
+        for a in jax.tree_util.tree_leaves(tr.params))
+
+    mesh2 = make_mesh((2, 2), ("data", "stage"), devices=jax.devices()[:4])
+    pipe = PipelineParallelTrainer(cfg, mesh2, n_microbatches=2, lr=0.05)
+    loss = pipe.fit_batch(rng.integers(0, 32, (4, 8)),
+                          rng.integers(0, 32, (4, 8)))
+    assert np.isfinite(loss)
+    assert all(a.dtype == jnp.float32 or not jnp.issubdtype(
+        a.dtype, jnp.floating)
+        for a in jax.tree_util.tree_leaves(
+            (pipe.stage_params, pipe.io_params)))

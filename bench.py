@@ -318,15 +318,27 @@ def bench_transformer() -> dict:
         n_heads=8 if on_tpu else 4, n_layers=6 if on_tpu else 2,
         d_ff=2048 if on_tpu else 128, max_len=S,
         dtype="bfloat16" if on_tpu else "float32")
-    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    # The realistic mixed-precision step (f32 masters, bf16 compute) —
+    # the same policy every trainer in the package uses; pure-bf16
+    # params would measure a config nobody should train with.
+    import dataclasses
+
+    from deeplearning4j_tpu.parallel.hybrid import _cast_floating
+
+    init_cfg = (cfg if not on_tpu
+                else dataclasses.replace(cfg, dtype="float32"))
+    params = tfm.init_params(init_cfg, jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
     targets = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
 
     @jax.jit
     def step(p):
-        loss, grads = jax.value_and_grad(
-            lambda q: tfm.lm_loss(cfg, q, tokens, targets))(p)
+        def loss_fn(q):
+            qc = (_cast_floating(q, jnp.bfloat16) if on_tpu else q)
+            return tfm.lm_loss(cfg, qc, tokens, targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
         return _sgd_tree(p, grads, 1e-3), loss
 
     state = {"p": params}
@@ -348,7 +360,8 @@ def bench_transformer() -> dict:
     return {"metric": f"TransformerLM train tokens/sec/chip (B{B}xS{S})",
             "unit": "tokens/sec", "value": round(B * S / sec, 1),
             "mfu": round(flops / sec / peak, 4), "params": n_params,
-            "batch": B, "seq_len": S, "dtype": cfg.dtype}
+            "batch": B, "seq_len": S,
+            "dtype": ("bf16-compute/f32-master" if on_tpu else cfg.dtype)}
 
 
 def bench_flash_ab() -> dict:

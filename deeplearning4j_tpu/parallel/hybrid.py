@@ -19,7 +19,9 @@ mesh, annotate shardings, let XLA insert collectives):
   stages. A test asserts step-for-step equality with the single-device
   model for both trainers.
 
-Both run unchanged on a v5e-8 or the 8-device virtual CPU mesh.
+Both run unchanged on a v5e-8 or the 8-device virtual CPU mesh, and both
+keep float32 master parameters regardless of the config's compute dtype
+(bf16 math via casts inside the loss; see `_master_f32`).
 """
 
 from __future__ import annotations

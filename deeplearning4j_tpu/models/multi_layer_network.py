@@ -97,6 +97,12 @@ class MultiLayerNetwork:
         self.params: Optional[List[Dict[str, jax.Array]]] = None
         self.state: Optional[List[Dict[str, jax.Array]]] = None
         self.updater_state: Optional[PyTree] = None
+        if (conf.conf.updater == "adadelta"
+                and any(lc.lr_multiplier != 1.0 for lc in conf.layers)):
+            raise ValueError(
+                "lr_multiplier is not supported with AdaDelta: its update "
+                "has no learning-rate term, so scaling the applied step "
+                "desynchronizes the accumulated-update state")
         self._updater = make_updater(conf.conf.updater_config())
         self._dtype = jnp.dtype(conf.conf.dtype)
         self._listeners: list = []
@@ -233,6 +239,18 @@ class MultiLayerNetwork:
 
     # ---- jitted steps -----------------------------------------------------
 
+    def _apply_lr_multipliers(self, updates):
+        """Per-layer learning-rate overrides (reference overRideFields):
+        scale each layer's updates by its conf's lr_multiplier — exactly
+        equivalent to a per-layer lr for every updater whose step is
+        linear in lr (all of ours except AdaDelta, which is rejected at
+        construction)."""
+        if all(lc.lr_multiplier == 1.0 for lc in self.conf.layers):
+            return updates
+        return [jax.tree_util.tree_map(lambda u, m=lc.lr_multiplier: u * m,
+                                       up)
+                for lc, up in zip(self.conf.layers, updates)]
+
     def _make_train_step(self, accum: int = 1):
         updater = self._updater
 
@@ -294,6 +312,7 @@ class MultiLayerNetwork:
                     lambda g: g / w_total, grads)
                 loss = loss / w_total
             updates, upd_state = updater.update(grads, upd_state, params)
+            updates = self._apply_lr_multipliers(updates)
             params = apply_updates(params, updates)
             return params, new_state, upd_state, loss
 
@@ -381,6 +400,11 @@ class MultiLayerNetwork:
         num_iterations, max_num_line_search_iterations and minimize."""
         from deeplearning4j_tpu.optimize.solver import Solver
 
+        if any(lc.lr_multiplier != 1.0 for lc in self.conf.layers):
+            raise ValueError(
+                "per-layer lr_multiplier is not honored by the "
+                "line-search solvers (they optimize one flat objective); "
+                "use the SGD path or clear the multipliers")
         if self.params is None:
             self.init()
         cfg = self.conf.conf
@@ -425,6 +449,8 @@ class MultiLayerNetwork:
         for i, lc in enumerate(self.conf.layers):
             if not isinstance(lc, (AutoEncoderConf, RBMConf)):
                 continue
+            if lc.lr_multiplier == 0.0:
+                continue  # frozen layer: no pretraining either
             updater = make_updater(cfg)
             upd_state = updater.init(self.params[i])
             if isinstance(lc, RBMConf):
@@ -432,6 +458,8 @@ class MultiLayerNetwork:
                 def step(p, us, xb, rng, _lc=lc, _upd=updater):
                     grads, err = rbm_cd_grads(_lc, p, xb, rng)
                     updates, us = _upd.update(grads, us, p)
+                    updates = jax.tree_util.tree_map(
+                        lambda u: u * _lc.lr_multiplier, updates)
                     return apply_updates(p, updates), us, err
             else:
                 @jax.jit
@@ -439,6 +467,8 @@ class MultiLayerNetwork:
                     err, grads = jax.value_and_grad(
                         lambda pp: ae_pretrain_loss(_lc, pp, xb, rng))(p)
                     updates, us = _upd.update(grads, us, p)
+                    updates = jax.tree_util.tree_map(
+                        lambda u: u * _lc.lr_multiplier, updates)
                     return apply_updates(p, updates), us, err
 
             it = 0

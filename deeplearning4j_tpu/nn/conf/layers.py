@@ -43,6 +43,11 @@ class LayerConf:
     use_dropconnect: bool = False
     l1: float = 0.0
     l2: float = 0.0
+    # Per-layer learning-rate scale (reference overRideFields lets a layer
+    # override the global lr).  Scaling the layer's updates is exactly a
+    # per-layer lr for lr-linear updaters; AdaDelta (no lr term) rejects
+    # it, and the line-search solvers do too.
+    lr_multiplier: float = 1.0
     distribution: Optional[dict] = None
     name: Optional[str] = None
 

@@ -70,6 +70,12 @@ class DataParallelTrainer:
         if net.params is None:
             net.init()
         ucfg = net.conf.conf.updater_config()
+        if shard_update and any(
+                lc.lr_multiplier != 1.0 for lc in net.conf.layers):
+            raise ValueError(
+                "shard_update does not support per-layer lr_multiplier "
+                "(the flat update shard has no layer structure); use the "
+                "replicated DP path")
         if shard_update and (ucfg.clip_norm is not None or ucfg.unit_norm):
             # These transforms need the WHOLE gradient tree (global norm /
             # per-leaf norms); a 1/N flat shard would silently compute a
@@ -114,6 +120,7 @@ class DataParallelTrainer:
                     jnp.asarray(s).dtype, jnp.floating) else s,
                 new_state)
             updates, upd_state = updater.update(grads, upd_state, params)
+            updates = net._apply_lr_multipliers(updates)
             params = apply_updates(params, updates)
             return params, new_state, upd_state, loss
 
@@ -287,6 +294,7 @@ class DataParallelTrainer:
             (loss, new_state), grads = jax.value_and_grad(
                 lossfn, has_aux=True)(params)
             updates, upd_state = updater.update(grads, upd_state, params)
+            updates = net._apply_lr_multipliers(updates)
             params = apply_updates(params, updates)
             loss = lax.pmean(loss, axis)
 

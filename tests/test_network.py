@@ -278,3 +278,58 @@ def test_batched_evaluate_matches_full():
     chunked = net.evaluate(ds.features, ds.labels, batch_size=40)  # ragged tail
     assert chunked.accuracy() == full.accuracy()
     assert chunked.stats() == full.stats()
+
+
+def test_per_layer_lr_multiplier():
+    """lr_multiplier scales a layer's updates (reference overRideFields
+    per-layer lr): 0.0 freezes the layer; 2.0 under SGD equals doubling
+    the lr for that layer exactly."""
+
+    def conf(mults):
+        layers = (DenseLayerConf(n_in=4, n_out=8, activation="tanh",
+                                 lr_multiplier=mults[0]),
+                  OutputLayerConf(n_in=8, n_out=3,
+                                  lr_multiplier=mults[1]))
+        return MultiLayerConfiguration(
+            conf=NeuralNetConfiguration(learning_rate=0.1, updater="sgd",
+                                        seed=0),
+            layers=layers)
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+
+    frozen = MultiLayerNetwork(conf((0.0, 1.0))).init()
+    w0 = np.asarray(frozen.params[0]["W"]).copy()
+    frozen.fit_batch(x, y)
+    np.testing.assert_array_equal(np.asarray(frozen.params[0]["W"]), w0)
+    assert not np.array_equal(np.asarray(frozen.params[1]["W"]),
+                              MultiLayerNetwork(conf((0.0, 1.0))).init()
+                              .params[1]["W"])
+
+    # 2x multiplier doubles the first step's update for that layer
+    a = MultiLayerNetwork(conf((2.0, 1.0))).init()
+    a.fit_batch(x, y)
+    base = MultiLayerNetwork(conf((1.0, 1.0))).init()
+    w_init = np.asarray(base.params[0]["W"]).copy()
+    base.fit_batch(x, y)
+    d_base = np.asarray(base.params[0]["W"]) - w_init
+    d_a = np.asarray(a.params[0]["W"]) - w_init
+    np.testing.assert_allclose(d_a, 2.0 * d_base, rtol=1e-4, atol=1e-7)
+
+
+def test_lr_multiplier_rejections():
+    import pytest as _p
+
+    layers = (DenseLayerConf(n_in=4, n_out=8, lr_multiplier=0.5),
+              OutputLayerConf(n_in=8, n_out=3))
+    with _p.raises(ValueError, match="AdaDelta"):
+        MultiLayerNetwork(MultiLayerConfiguration(
+            conf=NeuralNetConfiguration(updater="adadelta"), layers=layers))
+    net = MultiLayerNetwork(MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(optimization_algo="lbfgs"),
+        layers=layers)).init()
+    x = np.zeros((4, 4), np.float32)
+    y = np.eye(3, dtype=np.float32)[np.zeros(4, int)]
+    with _p.raises(ValueError, match="lr_multiplier"):
+        net.fit((x, y), epochs=1)

@@ -364,11 +364,13 @@ class MultiLayerNetwork:
         :1244). Returns the loss."""
         return float(self.fit_batch_async(x, y, mask, accum_steps))
 
-    def fit(self, data, epochs: int = 1) -> "MultiLayerNetwork":
+    def fit(self, data, epochs: int = 1, accum_steps: int = 1
+            ) -> "MultiLayerNetwork":
         """Train from a DataSetIterator-like iterable (yielding objects with
         .features/.labels/.mask or (x, y) tuples) or a single (x, y) pair.
         Runs `conf.pretrain` greedy pretraining first if configured
-        (reference fit(DataSetIterator) :1028)."""
+        (reference fit(DataSetIterator) :1028).  accum_steps > 1 applies
+        gradient accumulation to every batch (see fit_batch_async)."""
         import types
 
         if isinstance(data, types.GeneratorType):
@@ -385,7 +387,7 @@ class MultiLayerNetwork:
         for _ in range(epochs):
             for batch in _as_batches(data):
                 x, y, mask = batch
-                loss = self.fit_batch_async(x, y, mask)
+                loss = self.fit_batch_async(x, y, mask, accum_steps)
             _maybe_reset(data)
         if loss is not None:
             jax.block_until_ready(loss)

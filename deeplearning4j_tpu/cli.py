@@ -142,9 +142,17 @@ def cmd_train(args) -> int:
     # Prefetch shuffles/slices/pads batch b+1 on a host thread while the
     # device trains on b; async stepping lets the device pipeline steps
     # (host syncs once at evaluation below).
+    accum = max(1, int(props.get("train.accum.steps", args.accum)))
+    if accum > 1 and runner is not net:
+        print("-accum is a local-runtime feature; ignored under spmd")
+        accum = 1
     last = None
     for b in PrefetchDataSetIterator(_batches()):
-        last = runner.fit_batch_async(b.features, b.labels)
+        if accum > 1 and runner is net:
+            last = runner.fit_batch_async(b.features, b.labels,
+                                          accum_steps=accum)
+        else:
+            last = runner.fit_batch_async(b.features, b.labels)
     if last is not None:
         import jax
 
@@ -348,6 +356,9 @@ def build_parser() -> argparse.ArgumentParser:
                          choices=["binary", "txt"], default="binary")
     p_train.add_argument("-epochs", "--epochs", type=int, default=50)
     p_train.add_argument("-batch", "--batch", type=int, default=32)
+    p_train.add_argument("-accum", "--accum", type=int, default=1,
+                         help="gradient-accumulation microbatches per "
+                              "update (local runtime)")
     p_train.set_defaults(fn=cmd_train)
 
     p_lm = sub.add_parser(

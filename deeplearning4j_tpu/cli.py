@@ -138,6 +138,16 @@ def cmd_train(args) -> int:
                     b = type(b)(b.features[idx], b.labels[idx])
                 yield b
 
+    fresh_model = (args.model.startswith("zoo:")
+                   or not pathlib.Path(args.model).is_dir())
+    if net.conf.pretrain and fresh_model:
+        # Greedy layer-wise pretraining for DBN/deep-AE configs
+        # (reference pretrain-then-finetune, MultiLayerNetwork.java:148)
+        # — without this a `zoo:dbn-mnist` train would silently skip the
+        # step the model family depends on.  Resuming from a SAVED model
+        # dir skips it: re-pretraining finetuned weights would damage
+        # them.
+        net.pretrain(list(ds.shuffle(seed=0).batch_by(batch)), epochs=1)
     t0 = time.time()
     # Prefetch shuffles/slices/pads batch b+1 on a host thread while the
     # device trains on b; async stepping lets the device pipeline steps

@@ -156,3 +156,38 @@ def test_lm_spmd_runtime_trains_data_parallel(tmp_path, capsys):
     rc = main(["lm", "-output", str(out), "-generate", "abc",
                "-max-new", "4", "-temperature", "0"])
     assert rc == 0
+
+
+def test_train_runs_greedy_pretraining_for_dbn(tmp_path, capsys,
+                                               monkeypatch):
+    """A pretrain=True config (zoo:dbn-mnist) must actually pretrain from
+    the CLI — the loop previously called fit_batch directly and silently
+    skipped it."""
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork,
+    )
+
+    calls = []
+    orig = MultiLayerNetwork.pretrain
+
+    def spy(self, *a, **k):
+        calls.append(1)
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(MultiLayerNetwork, "pretrain", spy)
+    rng = np.random.default_rng(0)
+    x = rng.random((64, 16)).astype(np.float32)
+    labels = rng.integers(0, 3, 64)
+    csv = tmp_path / "d.csv"
+    np.savetxt(csv, np.concatenate([x, labels[:, None]], axis=1),
+               delimiter=",", fmt="%.5f")
+    conf_json = tmp_path / "dbn.json"
+    from deeplearning4j_tpu.models import get_model
+
+    conf_json.write_text(get_model(
+        "dbn-mnist", layer_sizes=(16, 8), n_out=3).to_json())
+    rc = main(["train", "-input", str(csv), "-model", str(conf_json),
+               "-output", str(tmp_path / "o"), "-epochs", "2",
+               "-batch", "32"])
+    assert rc == 0
+    assert calls, "CLI train must run greedy pretraining for pretrain confs"

@@ -205,6 +205,13 @@ def bench_lstm() -> dict:
         try:
             sec_fused = timed(True)
             result["fused_ms"] = round(sec_fused * 1e3, 3)
+            # NOT bit-identical arithmetic: the scan leg computes gates in
+            # the compute dtype (bf16 on TPU) while the fused kernel keeps
+            # gates+carry in f32 internally and stores bf16 outputs.  The
+            # A/B picks the faster wall-clock path; this field records
+            # what each leg computed so the winner's precision is explicit
+            # (recorded only once the fused leg actually ran).
+            result["numerics"] = {"scan": dtype, "fused": "f32-internal"}
             if sec_fused < sec_scan:
                 sec, result["path"] = sec_fused, "fused-pallas"
         except Exception as e:  # noqa: BLE001 - fused is optional

@@ -239,8 +239,10 @@ class BucketedSequenceIterator(DataSetIterator):
         return self.boundaries[-1]
 
     def __iter__(self):
+        # Module contract (see ArrayDataSetIterator): __iter__ is
+        # idempotent — re-iterating without reset() replays the same
+        # shuffle; reset() advances to the next epoch's shuffle.
         rng = np.random.default_rng(self.seed + self._epoch)
-        self._epoch += 1
         buckets = {}
         for i, s in enumerate(self.sequences):
             buckets.setdefault(self._bucket_of(len(s)), []).append(i)
@@ -283,7 +285,7 @@ class BucketedSequenceIterator(DataSetIterator):
             yield DataSet(x, y, mask=mask)
 
     def reset(self) -> None:
-        pass  # each __iter__ reshuffles with a fresh epoch seed
+        self._epoch += 1  # next epoch's shuffle (ArrayDataSetIterator parity)
 
     def batch_size(self) -> int:
         return self.batch

@@ -22,6 +22,7 @@ steps through a Solver/line-search object graph, here
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
@@ -44,6 +45,11 @@ from deeplearning4j_tpu.ops import losses as losses_mod
 from deeplearning4j_tpu.ops.updaters import apply_updates, make_updater
 
 PyTree = Any
+
+# Bound on compiled line-search solvers cached per fit() call (one per
+# distinct batch shape); beyond this, least-recently-used shapes are evicted
+# with a one-time warning.
+_SOLVER_CACHE_MAX = 8
 
 # Fused logit-space losses for stability: (activation, loss) -> fused loss name.
 _FUSED = {
@@ -97,6 +103,10 @@ class MultiLayerNetwork:
         self.params: Optional[List[Dict[str, jax.Array]]] = None
         self.state: Optional[List[Dict[str, jax.Array]]] = None
         self.updater_state: Optional[PyTree] = None
+        # A live DataParallelTrainer(shard_update=True) registers itself
+        # here while it owns the (sharded) optimizer state; checkpoint
+        # paths pull through runtime.checkpoint.published_updater_state.
+        self._updater_state_owner = None
         if (conf.conf.updater == "adadelta"
                 and any(lc.lr_multiplier != 1.0 for lc in conf.layers)):
             raise ValueError(
@@ -329,6 +339,10 @@ class MultiLayerNetwork:
         microbatch."""
         if self.params is None:
             self.init()
+        # Direct training owns its optimizer state: drop any registration
+        # left by an abandoned (un-finalized) sharded trainer so it can't
+        # clobber the live state at a later checkpoint boundary.
+        self._updater_state_owner = None
         if self.updater_state is None:
             # A sharded-update trainer owned the optimizer state (see
             # DataParallelTrainer.finalize); direct training restarts
@@ -422,17 +436,29 @@ class MultiLayerNetwork:
         # the batch is a traced argument of the solver step, so iterating
         # epochs x minibatches never recompiles (reference keeps one
         # optimizer object per fit, BaseOptimizer.java:124).  Full-batch
-        # data is simply the single-shape case.
+        # data is simply the single-shape case.  The cache is guarded,
+        # not evicted: ragged streams with many distinct shapes warn once
+        # (each shape costs an XLA compile) but keep their compiled steps
+        # — eviction would turn cyclic shape streams into permanent
+        # per-batch recompiles, strictly worse than the memory it saves.
         batches = list(_as_batches(data))
         solvers: Dict[tuple, Any] = {}
+        warned_shapes = False
         for _ in range(epochs):
             for x, y, mask in batches:
                 key = (np.shape(x), np.shape(y),
                        None if mask is None else np.shape(mask))
                 solver = solvers.get(key)
                 if solver is None:
+                    if len(solvers) >= _SOLVER_CACHE_MAX and not warned_shapes:
+                        warnings.warn(
+                            f"fit() with a line-search solver saw more "
+                            f"than {_SOLVER_CACHE_MAX} distinct batch "
+                            f"shapes; each shape compiles (and retains) "
+                            f"its own solver step. Pad/bucket batches to "
+                            f"a fixed set of shapes to bound compiles.")
+                        warned_shapes = True
                     solver = solvers[key] = make_solver(x, y, mask)
-                solver._x0 = self.params_flat()
                 loss = solver.fit_model(x, y, mask)
                 self._iteration += 1
                 for listener in self._listeners:

@@ -110,7 +110,7 @@ class Solver:
         construction."""
         from jax.flatten_util import ravel_pytree
 
-        flat0, unravel = ravel_pytree(net.params)
+        _, unravel = ravel_pytree(net.params)
         rng = jax.random.PRNGKey(0)
 
         def f(vec, xb, yb, maskb, state):
@@ -118,7 +118,6 @@ class Solver:
             return loss
 
         solver = cls(f, model=net, **kwargs)
-        solver._x0 = np.asarray(flat0)
         solver._unravel = unravel
         solver._bound = (jnp.asarray(x), jnp.asarray(y),
                          None if mask is None else jnp.asarray(mask))
@@ -130,7 +129,12 @@ class Solver:
         result back into the model. Returns the final score.
 
         With arguments, optimizes over that batch (same shapes reuse the
-        compiled step); without, uses the batch bound at for_model time."""
+        compiled step); without, uses the batch bound at for_model time.
+        The starting point is re-read from the model on EVERY call, so
+        repeated fit_model(x2, y2) minibatch calls continue from the latest
+        params rather than silently restarting from the for_model snapshot."""
+        from jax.flatten_util import ravel_pytree
+
         net = self.model
         if x is None:
             x, y, mask = self._bound
@@ -138,7 +142,8 @@ class Solver:
             x = jnp.asarray(x)
             y = jnp.asarray(y)
             mask = None if mask is None else jnp.asarray(mask)
-        best = self.optimize(self._x0, x, y, mask, net.state)
+        x0 = jnp.asarray(ravel_pytree(net.params)[0])
+        best = self.optimize(x0, x, y, mask, net.state)
         net.params = self._unravel(jnp.asarray(best))
         if any(s for s in net.state):  # stateful layers (e.g. batch-norm):
             # advance running statistics once per solve — the objective is

@@ -352,18 +352,16 @@ class DataParallelTrainer:
             net.params, net.state, self._opt_shard, loss = self._step_fn(
                 net.params, net.state, self._opt_shard, xs, ys, rng, ms)
             # The TRAINER owns the (sharded) optimizer state while this
-            # mode runs.  With listeners registered (e.g. a periodic
-            # CheckpointListener — they force a host sync anyway) the
-            # per-layer form is published every step so mid-run
-            # checkpoints keep trained moments; otherwise the net's copy
-            # is cleared, so a checkpoint taken without finalize() skips
-            # the state rather than silently saving stale zeros, and
-            # direct net.fit_batch restarts with fresh moments instead
-            # of a structure-mismatch crash.
-            if net._listeners:
-                self.sync_updater_state_to_net()
-            else:
-                net.updater_state = None
+            # mode runs: the net's copy is cleared (so direct
+            # net.fit_batch restarts with fresh moments instead of a
+            # structure-mismatch crash) and the trainer registers itself
+            # as the owner, so save_model/checkpoint paths
+            # (runtime.checkpoint.published_updater_state) pull the
+            # sharded moments ON DEMAND at checkpoint boundaries — no
+            # per-step publish cost, no finalize() needed for a
+            # mid-run checkpoint to keep trained moments.
+            net.updater_state = None
+            net._updater_state_owner = self
         elif self.sync_every == 1:
             net.params, net.state, net.updater_state, loss = self._step_fn(
                 net.params, net.state, net.updater_state, xs, ys, rng, ms)
@@ -431,6 +429,8 @@ class DataParallelTrainer:
         if self.sync_every > 1 and self._rep is not None:
             self._average_params()
         self.sync_updater_state_to_net()
+        if getattr(self.net, "_updater_state_owner", None) is self:
+            self.net._updater_state_owner = None
 
     def scaling_report(self) -> dict:
         if self.shard_update:

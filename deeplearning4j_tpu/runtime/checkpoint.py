@@ -93,6 +93,21 @@ def _atomic_savez(path: os.PathLike, arrays: Dict[str, np.ndarray]) -> None:
 # --------------------------------------------------------------------------
 # Model save/load: conf JSON + params (the reference shipping format)
 
+def published_updater_state(net):
+    """The net's updater state, publishing from a live sharded trainer first.
+
+    `DataParallelTrainer(shard_update=True)` owns the (ZeRO-1 sharded)
+    optimizer state while it runs and clears `net.updater_state`; saving the
+    net directly mid-run would silently drop the moments. The trainer
+    registers itself as `net._updater_state_owner`, and every save path here
+    pulls through this helper so mid-run checkpoints keep trained moments
+    without the user having to call `trainer.finalize()` first."""
+    owner = getattr(net, "_updater_state_owner", None)
+    if owner is not None:
+        owner.sync_updater_state_to_net()
+    return getattr(net, "updater_state", None)
+
+
 def save_model(net, directory: os.PathLike, *, save_updater: bool = False
                ) -> pathlib.Path:
     """Write `conf.json` + `params.npz` (+ `updater.npz` when
@@ -101,8 +116,9 @@ def save_model(net, directory: os.PathLike, *, save_updater: bool = False
     directory.mkdir(parents=True, exist_ok=True)
     (directory / "conf.json").write_text(net.conf.to_json())
     tree_to_npz(directory / "params.npz", net.params)
-    if save_updater and getattr(net, "updater_state", None) is not None:
-        tree_to_npz(directory / "updater.npz", net.updater_state)
+    upd = published_updater_state(net) if save_updater else None
+    if upd is not None:
+        tree_to_npz(directory / "updater.npz", upd)
     meta = {"format": 1, "num_params": int(net.num_params()),
             "saved_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
     (directory / "meta.json").write_text(json.dumps(meta, indent=2))
@@ -269,7 +285,7 @@ class CheckpointListener:
     def iteration_done(self, model, iteration: int, score: float) -> None:
         if iteration % self.every != 0:
             return
-        upd = getattr(model, "updater_state", None) if self.save_updater else None
+        upd = published_updater_state(model) if self.save_updater else None
         save_checkpoint(self.directory, iteration, model.params,
                         updater_state=upd, extra={"score": float(score)},
                         keep=self.keep)
@@ -355,7 +371,7 @@ class AsyncCheckpointListener(CheckpointListener):
                 lambda a: a.copy() if isinstance(a, jax.Array) else a,
                 tree)
 
-        upd = (snap(getattr(model, "updater_state", None))
+        upd = (snap(published_updater_state(model))
                if self.save_updater else None)
         self._queue.put((iteration, snap(model.params), upd, score))
 

@@ -198,6 +198,33 @@ class TestBucketedSequenceIterator:
                   for ds in it]
         assert np.isfinite(losses).all()
 
+    def test_iter_idempotent_reset_advances_epoch(self):
+        """Module contract (ArrayDataSetIterator parity): re-iterating
+        WITHOUT reset replays the identical shuffle (incidental extra
+        passes — len scans, eval reuse — stay deterministic); reset()
+        advances to the next epoch's shuffle, so fit()'s
+        reset-after-each-epoch sees a fresh order every epoch."""
+        from deeplearning4j_tpu.datasets.iterators import (
+            BucketedSequenceIterator,
+        )
+
+        seqs, labels, _ = self._ragged(seed=6)
+        it = BucketedSequenceIterator(seqs, labels, batch_size=8, seed=7)
+
+        def epoch():
+            return [(np.asarray(ds.features), np.asarray(ds.mask))
+                    for ds in it]
+
+        first, replay = epoch(), epoch()
+        assert len(replay) == len(first)
+        for (fa, ma), (fb, mb) in zip(first, replay):
+            np.testing.assert_array_equal(fa, fb)
+            np.testing.assert_array_equal(ma, mb)
+        it.reset()  # next epoch: fresh shuffle
+        second = epoch()
+        assert not all(np.array_equal(a[0], b[0])
+                       for a, b in zip(first, second))
+
     def test_per_sequence_labels(self):
         from deeplearning4j_tpu.datasets.iterators import (
             BucketedSequenceIterator,

@@ -76,6 +76,9 @@ def decode_step(cfg: TransformerConfig, params: dict, cache: dict,
         vs.append(nv)
         x = x + a
         h = _layer_norm(layer["ln2"], x)
+        # Dense-masked MoE (capacity_factor=0): exact, no drops — matches
+        # apply()'s inference default, preserving this module's
+        # cache-path == full-recompute contract for MoE configs.
         x = x + (_moe(layer["moe"], h) if "moe" in layer
                  else _mlp(layer["mlp"], h))
     x = _layer_norm(params["ln_f"], x)

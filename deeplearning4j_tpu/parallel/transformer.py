@@ -24,6 +24,7 @@ model; tests assert step-for-step equivalence between the two.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from typing import Optional
 
@@ -44,6 +45,12 @@ class TransformerConfig:
     n_layers: int = 2
     d_ff: int = 256
     n_experts: int = 0          # 0 = dense MLP; >0 = top-1 MoE
+    # Per-expert buffer size as a multiple of tokens/n_experts (Switch
+    # Transformer capacity factor).  >0: capacity-based dispatch — each
+    # expert computes ONLY its gathered buffer, so MoE FLOPs scale with
+    # this factor, not with n_experts.  0: dense-masked compute (every
+    # expert sees every token; exact, no drops — the dispatch oracle).
+    moe_capacity_factor: float = 1.25
     max_len: int = 512
     dtype: str = "float32"
     attn_bias: bool = False     # GPT-2-style q/k/v/o projection biases
@@ -205,12 +212,11 @@ def _mlp(p, x):
     return jnp.einsum("bsf,fd->bsd", h, p["w2"]) + p["b2"]
 
 
-def _moe(p, x):
-    """Top-1 MoE over full arrays, batched over the expert dim so GSPMD
-    partitions experts across the model axis (expert parallelism). Dense
-    masked compute: every expert sees every token, the combine weight
-    zeroes non-routed pairs — exact, no capacity dropping; all-to-all
-    dispatch is an optimization left to XLA's partitioner."""
+def _moe_dense(p, x):
+    """Top-1 MoE, dense-masked compute: every expert sees every token and
+    the combine weight zeroes non-routed pairs — exact (no capacity
+    drops) but O(n_experts) FLOPs.  Kept as the correctness ORACLE for
+    `_moe_dispatch`; select with cfg.moe_capacity_factor = 0."""
     logits = jnp.einsum("bsd,de->bse", x, p["gate"])
     choice = jnp.argmax(logits, axis=-1)                       # [B,S]
     gate_w = jax.nn.softmax(logits, axis=-1)                   # [B,S,E]
@@ -223,10 +229,76 @@ def _moe(p, x):
     return jnp.einsum("ebsd,bse->bsd", y, combine)
 
 
+def _moe_dispatch(p, x, capacity_factor: float,
+                  mesh: Optional[Mesh] = None,
+                  axes: MeshAxes = MeshAxes()):
+    """Capacity-based top-1 dispatch (the Switch Transformer routing rule,
+    PAPERS.md Fedus et al.): tokens are scattered into a static
+    [E, C, d] buffer with C = ceil(capacity_factor * tokens / E), each
+    expert computes ONLY its buffer, outputs gather back weighted by the
+    router probability.  Expert FLOPs therefore scale with the capacity
+    factor, NOT with n_experts.  Tokens past an expert's capacity (in
+    batch-major order) contribute nothing to the branch — identity via
+    the surrounding residual, the standard Switch drop rule.
+
+    Static shapes throughout (scatter/gather via `.at[]` / advanced
+    indexing), so the routing is jit/GSPMD-clean; with a mesh the buffer
+    is sharded over the model axis on E, placing each expert's compute
+    on its owner (XLA inserts the token all-to-all)."""
+    B, S, d = x.shape
+    E = p["w1"].shape[0]
+    N = B * S
+    C = max(1, min(N, int(math.ceil(capacity_factor * N / E))))  # static
+    xf = x.reshape(N, d)
+    logits = xf @ p["gate"]                                    # [N,E]
+    gate_w = jax.nn.softmax(logits, axis=-1)
+    choice = jnp.argmax(logits, axis=-1)                       # [N]
+    top_w = jnp.take_along_axis(gate_w, choice[:, None], 1)[:, 0]
+    onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)
+    # 0-based slot of each token within its expert's buffer (batch-major
+    # priority), C and above = overflow.
+    slot = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=1) - 1
+    keep = (slot < C).astype(x.dtype)                          # [N]
+    slot = jnp.clip(slot, 0, C - 1)
+    buf = jnp.zeros((E, C, d), x.dtype).at[choice, slot].add(
+        xf * keep[:, None])
+
+    def constrain(a):
+        if mesh is None:
+            return a
+        return lax.with_sharding_constraint(
+            a, NamedSharding(mesh, P(axes.model, None, None)))
+
+    buf = constrain(buf)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", buf, p["w1"])
+                    + p["b1"][:, None, :])
+    y = jnp.einsum("ecf,efd->ecd", h, p["w2"]) + p["b2"][:, None, :]
+    y = constrain(y)
+    # Each kept token owns its slot exclusively; dropped tokens read a
+    # foreign slot but are zeroed by `keep`.
+    out = y[choice, slot] * (top_w * keep)[:, None]
+    return out.reshape(B, S, d)
+
+
+def _moe(p, x, capacity_factor: float = 0.0,
+         mesh: Optional[Mesh] = None, axes: MeshAxes = MeshAxes()):
+    """MoE block: capacity-based dispatch when capacity_factor > 0
+    (the FLOP-saving default), dense-masked oracle otherwise."""
+    if capacity_factor > 0:
+        return _moe_dispatch(p, x, capacity_factor, mesh, axes)
+    return _moe_dense(p, x)
+
+
 def apply(cfg: TransformerConfig, params: dict, tokens: jax.Array,
           mesh: Optional[Mesh] = None, axes: MeshAxes = MeshAxes(),
-          causal: bool = True) -> jax.Array:
-    """tokens:[B,S] int32 -> logits [B,S,V]. Pass mesh to parallelize."""
+          causal: bool = True, train: bool = False) -> jax.Array:
+    """tokens:[B,S] int32 -> logits [B,S,V]. Pass mesh to parallelize.
+
+    MoE routing: `train=True` (the lm_loss path) uses capacity-based
+    dispatch — FLOP-saving but drops overflow tokens, so logits can
+    depend on batch composition.  The inference default is the exact
+    dense-masked path, keeping scoring deterministic per sequence and
+    bit-compatible with the KV-cached `generation.decode_step`."""
 
     def constrain(a):
         if mesh is None:
@@ -241,8 +313,9 @@ def apply(cfg: TransformerConfig, params: dict, tokens: jax.Array,
                       mesh, axes, causal)
         x = constrain(x)
         h = _layer_norm(layer["ln2"], x)
-        x = x + (_moe(layer["moe"], h) if "moe" in layer
-                 else _mlp(layer["mlp"], h))
+        cf = cfg.moe_capacity_factor if train else 0.0
+        x = x + (_moe(layer["moe"], h, cf, mesh, axes)
+                 if "moe" in layer else _mlp(layer["mlp"], h))
         x = constrain(x)
     x = _layer_norm(params["ln_f"], x)
     return jnp.einsum("bsd,dv->bsv", x, params["head"])
@@ -251,8 +324,9 @@ def apply(cfg: TransformerConfig, params: dict, tokens: jax.Array,
 def lm_loss(cfg: TransformerConfig, params: dict, tokens: jax.Array,
             targets: jax.Array, mesh: Optional[Mesh] = None,
             axes: MeshAxes = MeshAxes()) -> jax.Array:
-    """Mean next-token cross-entropy over the full batch."""
-    logits = apply(cfg, params, tokens, mesh, axes)
+    """Mean next-token cross-entropy over the full batch (training mode:
+    MoE layers route with capacity-based dispatch)."""
+    logits = apply(cfg, params, tokens, mesh, axes, train=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)

@@ -21,8 +21,11 @@ def _cfg(**kw):
     return tfm.TransformerConfig(**base)
 
 
-def test_decode_step_matches_full_forward():
-    cfg = _cfg()
+@pytest.mark.parametrize("n_experts", [0, 4])
+def test_decode_step_matches_full_forward(n_experts):
+    """Cache path == full recompute — including MoE configs, where both
+    sides must use the exact dense routing (apply()'s inference default)."""
+    cfg = _cfg(n_experts=n_experts)
     params = tfm.init_params(cfg, jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, (2, 10)).astype(np.int32)

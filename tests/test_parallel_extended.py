@@ -135,6 +135,114 @@ class TestRingFlashAttention:
                                    atol=2e-6)
 
 
+class TestMoEDispatch:
+    """Capacity-based dispatch vs the dense-masked oracle (VERDICT r3 #3)."""
+
+    def _moe_params(self, e, d=16, f=32, seed=0):
+        k = jax.random.PRNGKey(seed)
+        ks = jax.random.split(k, 3)
+        return {
+            "gate": jax.random.normal(ks[0], (d, e), jnp.float32) * 0.5,
+            "w1": jax.random.normal(ks[1], (e, d, f)) / np.sqrt(d),
+            "b1": jnp.zeros((e, f)),
+            "w2": jax.random.normal(ks[2], (e, f, d)) / np.sqrt(f),
+            "b2": jnp.zeros((e, d)),
+        }
+
+    def test_dispatch_matches_dense_oracle_at_full_capacity(self):
+        """capacity = all tokens -> no drops -> bitwise-same routing as the
+        dense-masked oracle, for values AND gradients."""
+        e = 4
+        p = self._moe_params(e)
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 8, 16)),
+                        jnp.float32)
+        got = tfm._moe_dispatch(p, x, capacity_factor=float(e))
+        want = tfm._moe_dense(p, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+        g_got = jax.grad(lambda p_: jnp.sum(
+            tfm._moe_dispatch(p_, x, float(e)) ** 2))(p)
+        g_want = jax.grad(lambda p_: jnp.sum(
+            tfm._moe_dense(p_, x) ** 2))(p)
+        for a, b in zip(jax.tree_util.tree_leaves(g_got),
+                        jax.tree_util.tree_leaves(g_want)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4)
+
+    def test_overflow_tokens_drop_to_identity(self):
+        """With capacity C, at most E*C tokens get a nonzero branch output
+        (Switch drop rule: overflow rides the residual untouched)."""
+        e = 4
+        p = self._moe_params(e, seed=3)
+        n = 32
+        x = jnp.asarray(np.random.default_rng(1).standard_normal((1, n, 16)),
+                        jnp.float32)
+        out = tfm._moe_dispatch(p, x, capacity_factor=0.25)  # C = 2
+        nonzero_rows = int(np.sum(
+            np.any(np.abs(np.asarray(out))[0] > 0, axis=-1)))
+        assert nonzero_rows <= e * 2
+        # and the kept tokens match the oracle exactly
+        oracle = np.asarray(tfm._moe_dense(p, x))[0]
+        outn = np.asarray(out)[0]
+        kept = np.any(np.abs(outn) > 0, axis=-1)
+        np.testing.assert_allclose(outn[kept], oracle[kept], atol=1e-5)
+
+    def test_expert_flops_scale_with_capacity_not_n_experts(self):
+        """The point of dispatch: quadrupling n_experts at fixed capacity
+        factor must NOT quadruple FLOPs (dense-masked does)."""
+
+        def flops(fn, p, x):
+            c = jax.jit(fn).lower(p, x).compile().cost_analysis()
+            if isinstance(c, list):  # older jax returns [dict]
+                c = c[0]
+            return float(c["flops"])
+
+        x = jnp.asarray(np.random.default_rng(2).standard_normal((4, 32, 16)),
+                        jnp.float32)
+        disp = lambda p, x: tfm._moe_dispatch(p, x, 1.25)  # noqa: E731
+        f4 = flops(disp, self._moe_params(4), x)
+        f16 = flops(disp, self._moe_params(16), x)
+        assert f16 < 1.7 * f4, (f4, f16)
+        dense = lambda p, x: tfm._moe_dense(p, x)  # noqa: E731
+        d4 = flops(dense, self._moe_params(4), x)
+        d16 = flops(dense, self._moe_params(16), x)
+        assert d16 > 3.0 * d4, (d4, d16)  # the oracle DOES scale with E
+
+    def test_apply_uses_dispatch_under_mesh(self):
+        """Full model equivalence in TRAIN mode (dispatch active): apply()
+        must agree between mesh (GSPMD dp/sp/tp over 8 devices) and single
+        device — routing is deterministic either way."""
+        cfg = tfm.TransformerConfig(vocab_size=31, d_model=16, n_heads=4,
+                                    n_layers=1, d_ff=32, n_experts=4,
+                                    max_len=32)
+        mesh = make_mesh((2, 2, 2), ("data", "seq", "model"),
+                         devices=_all_devices(8))
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.asarray(
+            np.random.default_rng(3).integers(0, cfg.vocab_size, (4, 16)),
+            jnp.int32)
+        single = tfm.apply(cfg, params, tokens, train=True)
+        sharded = jax.jit(lambda p, t: tfm.apply(
+            cfg, p, t, mesh=mesh, train=True))(params, tokens)
+        np.testing.assert_allclose(np.asarray(sharded), np.asarray(single),
+                                   atol=2e-5)
+
+    def test_inference_apply_is_dense_and_matches_decode_contract(self):
+        """apply()'s inference default must be batch-composition-independent
+        (dense MoE, no drops): scoring one sequence alone equals scoring it
+        co-batched — the property generation.decode_step relies on."""
+        cfg = tfm.TransformerConfig(vocab_size=31, d_model=16, n_heads=4,
+                                    n_layers=1, d_ff=32, n_experts=4,
+                                    max_len=32)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(1))
+        rng = np.random.default_rng(4)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 10)),
+                             jnp.int32)
+        batched = np.asarray(tfm.apply(cfg, params, tokens))[0]
+        alone = np.asarray(tfm.apply(cfg, params, tokens[:1]))[0]
+        np.testing.assert_allclose(batched, alone, atol=1e-5)
+
+
 def _gather(tree):
     return jax.tree_util.tree_map(np.asarray, tree)
 

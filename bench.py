@@ -296,16 +296,58 @@ def bench_scaling() -> dict:
 
     one = throughput(1)
     if n < 2:
-        return {"metric": "AlexNet-CIFAR10 DP scaling efficiency 1->8",
-                "unit": "fraction", "value": None,
-                "one_chip_examples_per_sec": round(one, 1),
-                "note": f"only {n} device(s) visible; efficiency needs >1"}
+        # No multi-chip hardware: still emit a NUMBER — the same 1-vs-8
+        # measurement on an 8-virtual-CPU-device mesh in a child process.
+        # That is a DP-plumbing check (shard_map + psum compile and scale
+        # mechanically), NOT an ICI efficiency; the row says so.
+        row = {"metric": "AlexNet-CIFAR10 DP scaling efficiency 1->8",
+               "unit": "fraction", "value": None,
+               "one_chip_examples_per_sec": round(one, 1),
+               "note": f"only {n} real device(s); real-ICI efficiency "
+                       f"needs hardware"}
+        try:
+            virt = _virtual_scaling_curve()
+        except Exception as e:  # noqa: BLE001 - plumbing row is best-effort
+            row["virtual_cpu_error"] = f"{type(e).__name__}: {e}"
+            return row
+        row["value"] = virt["value"]
+        row["measured_on"] = (
+            "virtual-cpu-8 plumbing check, not ICI: 8 virtual devices "
+            "share one host's cores, so aggregate throughput cannot "
+            "scale and efficiency ~= 1/8 is the EXPECTED healthy value")
+        row["virtual_cpu_curve"] = {
+            k: virt.get(k) for k in ("one_chip_examples_per_sec",
+                                     "8_chip_examples_per_sec")}
+        return row
     many = throughput(n)
     return {"metric": f"AlexNet-CIFAR10 DP scaling efficiency 1->{n}",
             "unit": "fraction",
             "value": round(many / (n * one), 4),
             "one_chip_examples_per_sec": round(one, 1),
             f"{n}_chip_examples_per_sec": round(many, 1)}
+
+
+def _virtual_scaling_curve() -> dict:
+    """bench_scaling re-run in a child with 8 virtual CPU devices (env
+    scrubbed so a wedged TPU tunnel cannot hang the child at interpreter
+    startup).  Returns the child's parsed row."""
+    import subprocess
+
+    from __graft_entry__ import scrub_tpu_env
+
+    env = scrub_tpu_env(dict(os.environ), n_devices=8)
+    env["BENCH_SCALING_INNER"] = "1"
+    env.pop("BENCH_CHILD", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")], env=env,
+        capture_output=True, text=True,
+        timeout=float(os.environ.get("BENCH_SCALING_TIMEOUT", 1200)))
+    line = _first_json_line(proc.stdout)
+    if line is None:
+        raise RuntimeError(
+            f"virtual-scaling child produced no JSON (rc={proc.returncode}, "
+            f"stderr tail: {proc.stderr.strip().splitlines()[-1:]}")
+    return json.loads(line)
 
 
 def bench_transformer() -> dict:
@@ -553,6 +595,12 @@ def _first_json_line(text: str):
 
 
 def main() -> int:
+    if os.environ.get("BENCH_SCALING_INNER"):
+        # Child of _virtual_scaling_curve: 8 virtual CPU devices are
+        # already forced in this env; print the one scaling row and exit.
+        os.environ.pop("BENCH_SCALING_INNER")
+        print(json.dumps(bench_scaling()), flush=True)
+        return 0
     if os.environ.get("BENCH_CHILD"):
         return run_suite()
     import re

@@ -158,29 +158,45 @@ class PipelineParallelTrainer:
         stage_fn = self._stage_fn
         compute_dtype = jnp.dtype(self.cfg.dtype)
 
+        n_stages = self.n_stages
+        k = -(-m // n_stages)          # ceil: per-stage microbatch share
+        m_pad = k * n_stages
+
         def step(stage_params, io_params, tokens, targets):
-            n_stages = lax.psum(1, stage_axis)
-            is_last = lax.axis_index(stage_axis) == n_stages - 1
+            stage = lax.axis_index(stage_axis)
 
             def loss_fn(sp, iop):
                 if compute_dtype != jnp.float32:  # f32 masters, bf16 math
                     sp = _cast_floating(sp, compute_dtype)
                     iop = _cast_floating(iop, compute_dtype)
-                x = iop["embed"][tokens]
-                s = tokens.shape[1]
-                x = x + iop["pos"][None, :s, :]
-                mb = x.reshape((m, x.shape[0] // m) + x.shape[1:])
-                y = gpipe_apply(stage_fn, sp, mb, stage_axis)
-                y = y.reshape(x.shape)
+                b, s = tokens.shape
+                mb_b = b // m
+                # Microbatch the TOKENS (tiny int arrays), pad to K*P
+                # slots, and slice THIS stage's blocked share: each stage
+                # embeds, pipelines, and scores only its own K
+                # microbatches — O(M/P * mb) persistent activations per
+                # device instead of the old full [M, mb] replication
+                # (and embed/head compute is split across stages too).
+                tok_mb = jnp.pad(tokens.reshape(m, mb_b, s),
+                                 ((0, m_pad - m), (0, 0), (0, 0)))
+                tgt_mb = jnp.pad(targets.reshape(m, mb_b, s),
+                                 ((0, m_pad - m), (0, 0), (0, 0)))
+                my_tok = lax.dynamic_slice_in_dim(tok_mb, stage * k, k, 0)
+                my_tgt = lax.dynamic_slice_in_dim(tgt_mb, stage * k, k, 0)
+                x = iop["embed"][my_tok] + iop["pos"][None, None, :s, :]
+                y = gpipe_apply(stage_fn, sp, x, stage_axis, m)
                 y = tfm._layer_norm(iop["ln_f"], y)
-                logits = jnp.einsum("bsd,dv->bsv", y, iop["head"])
+                logits = jnp.einsum("kbsd,dv->kbsv", y, iop["head"])
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 nll = -jnp.take_along_axis(
-                    logp, targets[..., None], axis=-1)[..., 0]
-                # loss lives on the LAST stage; the psum replicates its
-                # value AND (via psum-transposes-to-psum) scales every
-                # gradient by exactly n_stages — normalized below.
-                local = jnp.where(is_last, jnp.mean(nll), 0.0)
+                    logp, my_tgt[..., None], axis=-1)[..., 0]  # [K,mb_b,s]
+                # padding slots (global index >= m) contribute nothing
+                valid = (stage * k + jnp.arange(k) < m).astype(nll.dtype)
+                local = jnp.sum(nll * valid[:, None, None]) / (b * s)
+                # Disjoint per-stage partial means: the psum both
+                # replicates the true global mean AND (via
+                # psum-transposes-to-psum) scales every gradient by
+                # exactly n_stages — normalized below.
                 return lax.psum(local, stage_axis)
 
             loss, (g_stage, g_io) = jax.value_and_grad(
@@ -189,9 +205,9 @@ class PipelineParallelTrainer:
             # stage params: per-shard grads are n_stages x own-slice grad.
             g_stage = jax.tree_util.tree_map(
                 lambda g: lax.pmean(g * inv, data_axis), g_stage)
-            # io params: stage-partial (embed/pos accumulate on stage 0,
-            # ln_f/head on the last stage) -> sum across stages, then
-            # remove the same n_stages factor.
+            # io params: per-stage partial (each stage embeds/scores its
+            # own disjoint share) -> sum across stages, then remove the
+            # same n_stages factor.
             g_io = jax.tree_util.tree_map(
                 lambda g: lax.pmean(lax.psum(g, stage_axis) * inv,
                                     data_axis), g_io)

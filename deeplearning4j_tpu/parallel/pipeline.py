@@ -8,6 +8,16 @@ hop stage→stage with `lax.ppermute` inside a `lax.scan` over
 M + P - 1 ticks. The whole schedule — bubbles and all — is one compiled
 XLA program; `jax.grad` differentiates straight through the scan+ppermute
 for the backward pipeline.
+
+Memory discipline (VERDICT r3 #5): microbatches are NOT replicated to
+every stage. Each stage holds only its blocked 1/P share of the inputs
+and banks only its share of the outputs — O(M/P · mb) persistent per
+device plus O(mb) transients. At tick t the owner of microbatch t
+broadcasts it with a masked psum (stage 0 consumes it); the last stage's
+result is broadcast the same way and banked by the owner of that output
+slot. Bubble ticks skip the stage computation entirely via `lax.cond`
+(a real runtime branch under XLA — fill/drain ticks cost a no-op, not a
+garbage forward).
 """
 
 from __future__ import annotations
@@ -19,52 +29,71 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def gpipe_apply(stage_fn: Callable, stage_params, x_microbatches: jax.Array,
-                axis_name: str) -> jax.Array:
-    """Run microbatches through the stage pipeline.
+def gpipe_apply(stage_fn: Callable, stage_params, x_local: jax.Array,
+                axis_name: str, n_microbatches: int) -> jax.Array:
+    """Run the microbatch pipeline over this stage's LOCAL input share.
 
     stage_fn(local_params, x) -> y, same activation shape in and out.
     stage_params: LOCAL stage's params (leading stage dim already consumed
     by shard_map's in_spec, i.e. leaves are [1, ...]; indexed [0] here).
-    x_microbatches: [M, mb, ...] — every stage sees all microbatches
-    (replicated); only stage 0 consumes them.
-    Returns [M, mb, ...] outputs (valid on the LAST stage; other stages
-    return zeros — callers typically psum or select).
+    x_local: [K, mb, ...] — this stage's blocked share of the
+    n_microbatches real microbatches, K = ceil(M / P); stage s owns
+    global microbatches [s*K, (s+1)*K). Slots past n_microbatches are
+    padding and are never injected into the pipeline.
+    Returns [K, mb, ...]: this stage's share of the outputs in the same
+    blocked layout (padding slots stay zero).
     """
+    # Under shard_map, psum of a literal is the axis size as a concrete
+    # int at trace time — usable for static perm lists and scan lengths.
     n_stages = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
-    m = x_microbatches.shape[0]
+    k = x_local.shape[0]
+    m = n_microbatches
+    if k * n_stages < m:
+        raise ValueError(
+            f"x_local holds {k} slots/stage x {n_stages} stages "
+            f"< {m} microbatches; pad each stage's share to "
+            f"ceil(M/P) slots")
     local_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-
-    act_shape = x_microbatches.shape[1:]
+    act_shape = x_local.shape[1:]
 
     def tick(carry, t):
         incoming, outputs = carry
-        # stage 0 injects microbatch t (clamped; validity handled below)
-        mb = lax.dynamic_index_in_dim(
-            x_microbatches, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
-        x_in = jnp.where(stage == 0, mb, incoming)
-        y = stage_fn(local_params, x_in)
-        # last stage banks its result for ticks where it holds microbatch
-        # t - (n_stages - 1)
+        # Owner of microbatch t broadcasts it (masked psum — O(mb)
+        # transient on every stage, consumed by stage 0).
+        owner = t // k
+        mine = lax.dynamic_index_in_dim(
+            x_local, jnp.clip(t % k, 0, k - 1), axis=0, keepdims=False)
+        inject = jnp.logical_and(stage == owner, t < m)
+        mb_t = lax.psum(jnp.where(inject, mine, jnp.zeros_like(mine)),
+                        axis_name)
+        x_in = jnp.where(stage == 0, mb_t, incoming)
+        # Stage s holds real data only for ticks s <= t < s + m; bubble
+        # ticks skip the forward entirely (runtime branch).
+        active = jnp.logical_and(t >= stage, t < stage + m)
+        y = lax.cond(active,
+                     lambda a: stage_fn(local_params, a),
+                     lambda a: a, x_in)
+        # The last stage's result is microbatch out_idx = t - (P - 1);
+        # broadcast it and let the owner of that output slot bank it.
         out_idx = t - (n_stages - 1)
-        valid = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+        emit = jnp.logical_and(stage == n_stages - 1,
+                               jnp.logical_and(out_idx >= 0, out_idx < m))
+        y_out = lax.psum(jnp.where(emit, y, jnp.zeros_like(y)), axis_name)
+        bank = jnp.logical_and(stage == out_idx // k,
+                               jnp.logical_and(out_idx >= 0, out_idx < m))
         outputs = lax.cond(
-            valid,
+            bank,
             lambda o: lax.dynamic_update_index_in_dim(
-                o, y, jnp.clip(out_idx, 0, m - 1), axis=0),
+                o, y_out, jnp.clip(out_idx % k, 0, k - 1), axis=0),
             lambda o: o,
             outputs)
         nxt = lax.ppermute(y, axis_name, perm)
         return (nxt, outputs), None
 
-    init = (jnp.zeros(act_shape, x_microbatches.dtype),
-            jnp.zeros((m,) + act_shape, x_microbatches.dtype))
+    init = (jnp.zeros(act_shape, x_local.dtype),
+            jnp.zeros((k,) + act_shape, x_local.dtype))
     (_, outputs), _ = lax.scan(
         tick, init, jnp.arange(m + n_stages - 1))
-    # broadcast the last stage's outputs to every stage so downstream code
-    # (loss) is uniform SPMD
-    last = lax.psum(
-        jnp.where(stage == n_stages - 1, 1.0, 0.0) * outputs, axis_name)
-    return last
+    return outputs

@@ -111,6 +111,9 @@ class RNTN:
             logp = jax.nn.log_softmax(logits, axis=-1)
             nll = -jnp.take_along_axis(logp, label[..., None],
                                        axis=-1)[..., 0]
+            # mask here is (real-node AND carries-a-label): fully-labeled
+            # treebanks supervise every node (the reference's SST
+            # training), root-only corpora supervise just the root.
             data = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
             l2 = sum(jnp.sum(p * p) for k, p in params.items()
                      if k not in ("b", "bs"))
@@ -139,7 +142,7 @@ class RNTN:
         ada = {k: jnp.zeros_like(v) for k, v in self.params.items()}
         arrays = tuple(jnp.asarray(a) for a in (
             prog.is_leaf, prog.word, prog.left, prog.right, prog.label,
-            prog.mask))
+            prog.mask * prog.labeled))
         self.losses = []
         for _ in range(self.epochs):
             self.params, ada, loss = self._step(self.params, ada, *arrays)

@@ -167,6 +167,64 @@ the/DET happy/ADJ children/NOUN laughed/VERB loudly/ADV ./.
 rain/NOUN falls/VERB softly/ADV on/ADP the/DET roof/NOUN ./.
 we/PRON eat/VERB fresh/ADJ bread/NOUN and/CONJ cheese/NOUN ./.
 the/DET old/ADJ man/NOUN walks/VERB with/ADP a/DET cane/NOUN ./.
+my/PRON sister/NOUN paints/VERB bright/ADJ pictures/NOUN of/ADP flowers/NOUN ./.
+the/DET tired/ADJ workers/NOUN finished/VERB the/DET long/ADJ project/NOUN ./.
+four/NUM ships/NOUN sailed/VERB across/ADP the/DET calm/ADJ sea/NOUN ./.
+she/PRON carefully/ADV opened/VERB the/DET heavy/ADJ wooden/ADJ door/NOUN ./.
+the/DET doctor/NOUN and/CONJ the/DET nurse/NOUN help/VERB sick/ADJ patients/NOUN ./.
+a/DET strong/ADJ wind/NOUN blew/VERB through/ADP the/DET quiet/ADJ valley/NOUN ./.
+they/PRON often/ADV visit/VERB their/PRON grandmother/NOUN in/ADP spring/NOUN ./.
+the/DET young/ADJ artist/NOUN draws/VERB beautiful/ADJ portraits/NOUN quickly/ADV ./.
+five/NUM students/NOUN answered/VERB the/DET difficult/ADJ question/NOUN correctly/ADV ./.
+the/DET river/NOUN flows/VERB slowly/ADV through/ADP the/DET green/ADJ fields/NOUN ./.
+he/PRON never/ADV forgets/VERB an/DET important/ADJ meeting/NOUN ./.
+the/DET hungry/ADJ wolves/NOUN hunted/VERB near/ADP the/DET dark/ADJ forest/NOUN ./.
+our/PRON team/NOUN won/VERB the/DET final/ADJ match/NOUN easily/ADV ./.
+a/DET clever/ADJ student/NOUN solves/VERB hard/ADJ problems/NOUN fast/ADV ./.
+the/DET baker/NOUN sells/VERB warm/ADJ bread/NOUN every/DET morning/NOUN ./.
+six/NUM horses/NOUN ran/VERB across/ADP the/DET open/ADJ plain/NOUN ./.
+she/PRON wrote/VERB a/DET short/ADJ letter/NOUN to/ADP her/PRON mother/NOUN ./.
+the/DET busy/ADJ market/NOUN opens/VERB early/ADV on/ADP saturday/NOUN ./.
+i/PRON usually/ADV drink/VERB hot/ADJ coffee/NOUN with/ADP milk/NOUN ./.
+the/DET brave/ADJ firefighter/NOUN saved/VERB the/DET frightened/ADJ child/NOUN ./.
+small/ADJ boats/NOUN float/VERB on/ADP the/DET deep/ADJ lake/NOUN ./.
+the/DET engineer/NOUN designs/VERB safe/ADJ bridges/NOUN and/CONJ roads/NOUN ./.
+you/PRON should/VERB read/VERB this/DET interesting/ADJ article/NOUN ./.
+the/DET gray/ADJ clouds/NOUN covered/VERB the/DET bright/ADJ sky/NOUN ./.
+seven/NUM trees/NOUN grow/VERB behind/ADP the/DET white/ADJ fence/NOUN ./.
+the/DET curious/ADJ tourists/NOUN photographed/VERB the/DET ancient/ADJ castle/NOUN ./.
+my/PRON father/NOUN repairs/VERB broken/ADJ clocks/NOUN and/CONJ watches/NOUN ./.
+the/DET singer/NOUN performed/VERB a/DET famous/ADJ song/NOUN tonight/NOUN ./.
+wild/ADJ geese/NOUN fly/VERB north/ADV in/ADP early/ADJ summer/NOUN ./.
+the/DET cook/NOUN prepares/VERB tasty/ADJ soup/NOUN with/ADP fresh/ADJ vegetables/NOUN ./.
+eight/NUM players/NOUN practice/VERB on/ADP the/DET muddy/ADJ field/NOUN ./.
+she/PRON always/ADV smiles/VERB at/ADP her/PRON little/ADJ brother/NOUN ./.
+the/DET lazy/ADJ cat/NOUN sleeps/VERB under/ADP the/DET warm/ADJ blanket/NOUN ./.
+a/DET sudden/ADJ noise/NOUN woke/VERB the/DET sleeping/ADJ baby/NOUN ./.
+the/DET farmer/NOUN plants/VERB corn/NOUN and/CONJ beans/NOUN in/ADP april/NOUN ./.
+we/PRON watched/VERB a/DET wonderful/ADJ film/NOUN last/ADJ night/NOUN ./.
+the/DET mechanic/NOUN fixed/VERB the/DET old/ADJ engine/NOUN quickly/ADV ./.
+two/NUM eagles/NOUN circled/VERB above/ADP the/DET rocky/ADJ mountain/NOUN ./.
+the/DET polite/ADJ waiter/NOUN brought/VERB our/PRON delicious/ADJ dinner/NOUN ./.
+heavy/ADJ rain/NOUN flooded/VERB the/DET narrow/ADJ streets/NOUN yesterday/NOUN ./.
+the/DET librarian/NOUN quietly/ADV arranges/VERB the/DET new/ADJ books/NOUN ./.
+he/PRON proudly/ADV showed/VERB us/PRON his/PRON first/ADJ medal/NOUN ./.
+the/DET nervous/ADJ speaker/NOUN forgot/VERB his/PRON opening/ADJ line/NOUN ./.
+nine/NUM candles/NOUN burned/VERB on/ADP the/DET birthday/NOUN cake/NOUN ./.
+the/DET gardener/NOUN waters/VERB the/DET thirsty/ADJ plants/NOUN daily/ADV ./.
+cold/ADJ winds/NOUN blow/VERB from/ADP the/DET northern/ADJ hills/NOUN ./.
+the/DET pilot/NOUN lands/VERB the/DET huge/ADJ plane/NOUN smoothly/ADV ./.
+she/PRON and/CONJ her/PRON friend/NOUN play/VERB chess/NOUN on/ADP sunday/NOUN ./.
+the/DET angry/ADJ driver/NOUN honked/VERB at/ADP the/DET slow/ADJ truck/NOUN ./.
+ten/NUM soldiers/NOUN guarded/VERB the/DET main/ADJ gate/NOUN carefully/ADV ./.
+the/DET scientist/NOUN studies/VERB rare/ADJ butterflies/NOUN in/ADP the/DET jungle/NOUN ./.
+a/DET gentle/ADJ breeze/NOUN moves/VERB the/DET yellow/ADJ leaves/NOUN ./.
+the/DET judge/NOUN listened/VERB to/ADP the/DET long/ADJ argument/NOUN patiently/ADV ./.
+my/PRON uncle/NOUN builds/VERB strong/ADJ wooden/ADJ tables/NOUN ./.
+the/DET children/NOUN happily/ADV opened/VERB their/PRON colorful/ADJ presents/NOUN ./.
+fresh/ADJ snow/NOUN covered/VERB the/DET silent/ADJ village/NOUN overnight/ADV ./.
+the/DET manager/NOUN calmly/ADV explained/VERB the/DET new/ADJ rules/NOUN ./.
+bright/ADJ stars/NOUN shine/VERB over/ADP the/DET peaceful/ADJ desert/NOUN ./.
 """
 
 

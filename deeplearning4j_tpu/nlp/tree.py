@@ -129,6 +129,7 @@ class TreeProgram:
     right: np.ndarray     # [B, N] int32 buffer index of right child
     label: np.ndarray     # [B, N] int32 class label (0 where absent)
     mask: np.ndarray      # [B, N] float32 1 for real nodes
+    labeled: np.ndarray   # [B, N] float32 1 where the node CARRIES a label
     root: np.ndarray      # [B] int32 buffer index of the root
     n_nodes: int
 
@@ -142,7 +143,9 @@ def compile_trees(trees: Sequence[Tree], word_index,
     """Binarized trees → post-order programs, padded to a common length.
 
     word_index: dict word→int or callable. Labels default to 0 when a node
-    carries none.
+    carries none; the `labeled` array records which nodes actually carry
+    one (label=None ⇒ labeled=0), so losses can supervise only labeled
+    nodes — e.g. root-only sentence classification.
     """
     lookup = (word_index if callable(word_index)
               else lambda w: word_index.get(w, unk_index))
@@ -155,11 +158,12 @@ def compile_trees(trees: Sequence[Tree], word_index,
         index = {id(n): i for i, n in enumerate(nodes)}
         rows = []
         for n in nodes:
+            has = int(n.label is not None)
             if n.is_leaf():
-                rows.append((1, lookup(n.word), 0, 0, n.label or 0))
+                rows.append((1, lookup(n.word), 0, 0, n.label or 0, has))
             else:
                 l, r = (index[id(c)] for c in n.children)
-                rows.append((0, 0, l, r, n.label or 0))
+                rows.append((0, 0, l, r, n.label or 0, has))
         progs.append(rows)
 
     n = max_nodes or max(len(p) for p in progs)
@@ -170,15 +174,17 @@ def compile_trees(trees: Sequence[Tree], word_index,
     arrs = {k: np.zeros((b, n), np.int32)
             for k in ("is_leaf", "word", "left", "right", "label")}
     mask = np.zeros((b, n), np.float32)
+    labeled = np.zeros((b, n), np.float32)
     root = np.zeros(b, np.int32)
     for i, rows in enumerate(progs):
-        for j, (lf, w, l, r, lab) in enumerate(rows):
+        for j, (lf, w, l, r, lab, has) in enumerate(rows):
             arrs["is_leaf"][i, j] = lf
             arrs["word"][i, j] = w
             arrs["left"][i, j] = l
             arrs["right"][i, j] = r
             arrs["label"][i, j] = lab
+            labeled[i, j] = has
         mask[i, :len(rows)] = 1.0
         root[i] = len(rows) - 1
     return TreeProgram(arrs["is_leaf"], arrs["word"], arrs["left"],
-                       arrs["right"], arrs["label"], mask, root, n)
+                       arrs["right"], arrs["label"], mask, labeled, root, n)

@@ -121,6 +121,92 @@ class TestWord2VecSimilarityGate:
         assert len(near) == 5 and "array" not in near
 
 
+class TestRntnSentimentGate:
+    """RNTN trained on the bundled labeled review corpus must beat the
+    majority class on held-out ROOT sentiment (VERDICT r3 #6; reference
+    `BasicRNTNTest` trains on labeled trees and checks predictions).
+    The full reference call stack runs: PoStagger (bundled-corpus HMM) ->
+    TreeParser -> labeled Trees -> RNTN -> RNTNEval."""
+
+    @staticmethod
+    def _stratified_split(trees, seed, frac=0.8):
+        rng = np.random.default_rng(seed)
+        tr, te = [], []
+        for cls in (0, 1):
+            grp = [t for t in trees if t.label == cls]
+            idx = rng.permutation(len(grp))
+            k = int(frac * len(grp))
+            tr += [grp[i] for i in idx[:k]]
+            te += [grp[i] for i in idx[k:]]
+        return tr, te
+
+    def test_rntn_beats_majority_on_held_out_roots(self):
+        from deeplearning4j_tpu.models.rntn import RNTN, RNTNEval
+        from deeplearning4j_tpu.nlp.sentiment import sentiment_trees
+
+        trees = sentiment_trees()
+        assert len(trees) >= 90  # the bundled corpus parsed end to end
+        accs = []
+        for seed in (0, 1, 2):
+            train, test = self._stratified_split(trees, seed)
+            majority = max(np.mean([t.label for t in test]),
+                           1 - np.mean([t.label for t in test]))
+            assert majority == 0.5  # stratified: the baseline to beat
+            model = RNTN(num_classes=2, d=16, lr=0.05, epochs=100, seed=0)
+            model.fit(train)
+            ev = RNTNEval()
+            ev.eval(model, test)
+            accs.append(ev.root_accuracy())
+        mean_acc = float(np.mean(accs))
+        assert mean_acc >= 0.6, (
+            f"held-out root accuracy {accs} (mean {mean_acc:.3f}) does not "
+            f"beat the 0.5 majority baseline with margin")
+
+
+class TestPosTaggerGate:
+    """The out-of-the-box tagger (bundled corpus, no caller data) must tag
+    HELD-OUT hand-tagged sentences well — the capability the reference
+    got from shipping a pretrained OpenNLP model (PoStagger.java:248)."""
+
+    HELD_OUT = [
+        [("the", "DET"), ("quiet", "ADJ"), ("student", "NOUN"),
+         ("reads", "VERB"), ("in", "ADP"), ("the", "DET"),
+         ("library", "NOUN"), (".", ".")],
+        [("three", "NUM"), ("dogs", "NOUN"), ("chased", "VERB"),
+         ("the", "DET"), ("red", "ADJ"), ("ball", "NOUN"), (".", ".")],
+        [("she", "PRON"), ("slowly", "ADV"), ("opens", "VERB"),
+         ("a", "DET"), ("small", "ADJ"), ("box", "NOUN"), (".", ".")],
+        [("my", "PRON"), ("friend", "NOUN"), ("and", "CONJ"),
+         ("his", "PRON"), ("sister", "NOUN"), ("sing", "VERB"),
+         ("loudly", "ADV"), (".", ".")],
+        [("cold", "ADJ"), ("rain", "NOUN"), ("falls", "VERB"),
+         ("on", "ADP"), ("the", "DET"), ("empty", "ADJ"),
+         ("street", "NOUN"), (".", ".")],
+    ]
+
+    def test_default_tagger_held_out_accuracy(self):
+        from deeplearning4j_tpu.nlp.annotators import default_tagger
+
+        tagger = default_tagger()
+        correct = total = 0
+        for sent in self.HELD_OUT:
+            tokens = [w for w, _ in sent]
+            got = tagger.tag(tokens)
+            for (tok, want), (_, pred) in zip(sent, got):
+                total += 1
+                correct += int(want == pred)
+        acc = correct / total
+        assert acc >= 0.85, f"held-out tagging accuracy {acc:.3f} < 0.85"
+
+    def test_tagger_handles_unknown_words(self):
+        from deeplearning4j_tpu.nlp.annotators import default_tagger
+
+        got = dict(default_tagger().tag(
+            ["the", "zorbulous", "quibbler", "vanished", "."]))
+        # suffix/open-class fallback must produce plausible tags, not crash
+        assert got["the"] == "DET" and got["."] == "."
+
+
 class TestTransformerLmGate:
     """The flagship TransformerLM must actually learn real English text:
     byte-level LM on this repo's docs, loss must drop substantially."""

@@ -318,14 +318,31 @@ class Word2Vec(WordVectors):
     # ------------------------------------------------------------------
     # fit (reference Word2Vec.fit():103)
 
+    def _pair_producer(self, encoded, out_q) -> None:
+        """Background pair-chunk producer (reference parity: the
+        Word2Vec.java:145-258 thread pool existed to overlap exactly this
+        host work with training).  Epoch pair arrays are generated on a
+        worker thread — numpy releases the GIL for the heavy ops — while
+        the main thread keeps the device busy dispatching steps; the
+        1-deep queue bounds host memory to one epoch ahead."""
+        rng = np.random.default_rng(self.seed)
+        try:
+            for _ in range(self.epochs):
+                out_q.put(("pairs", self._make_pairs(encoded, rng)))
+            out_q.put(("done", None))
+        except BaseException as e:  # noqa: BLE001 - re-raised by consumer
+            out_q.put(("error", e))
+
     def fit(self, sentences) -> "Word2Vec":
+        import queue
+        import threading
+
         token_lists = self._sentences_to_tokens(sentences)
         if len(self.vocab) == 0:
             self.build_vocab(token_lists)
         if self.syn0.shape[0] != len(self.vocab):
             self.reset_weights()
         encoded = [self.vocab.encode(t) for t in token_lists]
-        rng = np.random.default_rng(self.seed)
         key = jax.random.PRNGKey(self.seed)
 
         use_hs = self.negative == 0
@@ -333,10 +350,22 @@ class Word2Vec(WordVectors):
         out = jnp.asarray(self.syn1 if use_hs else self.syn1neg)
         step = self._step
 
+        # Same rng object/order as the sequential loop had: the producer
+        # owns it and generates epochs in order -> bit-identical pairs.
+        pair_q: "queue.Queue" = queue.Queue(maxsize=1)
+        producer = threading.Thread(
+            target=self._pair_producer, args=(encoded, pair_q), daemon=True)
+        producer.start()
+
         total_pairs = None
         seen = 0
-        for epoch in range(self.epochs):
-            pairs = self._make_pairs(encoded, rng)
+        while True:
+            kind, payload = pair_q.get()
+            if kind == "error":
+                raise payload
+            if kind == "done":
+                break
+            pairs = payload
             if total_pairs is None:
                 total_pairs = max(len(pairs) * self.epochs, 1)
             B = self.batch_size
@@ -374,6 +403,7 @@ class Word2Vec(WordVectors):
                         syn0, out, chunk_dev[bi, :, 0], chunk_dev[bi, :, 1],
                         jnp.float32(lr), sub, valid)
                     seen += n_real
+        producer.join()
         self.syn0 = np.asarray(syn0)
         if use_hs:
             self.syn1 = np.asarray(out)

@@ -139,6 +139,17 @@ class TestWord2Vec:
         assert w2v.get_word_vector("notaword") is None
         assert np.isnan(w2v.similarity("apple", "notaword"))
 
+    def test_multi_epoch_fit_is_deterministic(self):
+        """The background pair producer must preserve the sequential
+        epoch order/rng: two identically-seeded multi-epoch fits give
+        bit-identical embeddings."""
+        def run():
+            w = Word2Vec(vector_length=16, window=3, epochs=3, seed=7,
+                         batch_size=256)
+            w.fit(CORPUS[:80])
+            return w.syn0
+        np.testing.assert_array_equal(run(), run())
+
 
 class TestGlove:
     def test_topics_separate(self):

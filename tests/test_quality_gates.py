@@ -121,6 +121,49 @@ class TestWord2VecSimilarityGate:
         assert len(near) == 5 and "array" not in near
 
 
+class TestLbfgsFinetuneGate:
+    """LBFGS must be usable on a REAL model, not just analytic test
+    functions (VERDICT r3 #8; reference exercises solvers on networks in
+    TestOptimizers.java / BaseOptimizer.java:124): an SGD-warm-started
+    digits MLP finetuned by the public solver fit path must reach a
+    target accuracy and improve on the warm start."""
+
+    def test_lbfgs_finetunes_digits_mlp(self):
+        from deeplearning4j_tpu.datasets.fetchers import digits_dataset
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.conf import (
+            DenseLayerConf, MultiLayerConfiguration,
+            NeuralNetConfiguration, OutputLayerConf)
+
+        train = digits_dataset("train")
+        test = digits_dataset("test")
+        x = train.features.reshape(len(train.features), -1).astype(np.float32)
+        y = train.labels.astype(np.float32)
+        xt = test.features.reshape(len(test.features), -1).astype(np.float32)
+
+        conf = MultiLayerConfiguration(
+            conf=NeuralNetConfiguration(
+                seed=0, learning_rate=0.05, updater="nesterovs",
+                optimization_algo="lbfgs", num_iterations=30),
+            layers=(DenseLayerConf(n_in=64, n_out=32, activation="tanh"),
+                    OutputLayerConf(n_in=32, n_out=10)))
+        net = MultiLayerNetwork(conf).init()
+        # SGD warm start (fit_batch is the direct step path regardless of
+        # the configured solver), then LBFGS finetune via the public
+        # solver fit path — which resumes from the CURRENT params.
+        rng = np.random.default_rng(0)
+        for _ in range(2):
+            order = rng.permutation(len(x))
+            for i in range(0, len(order) - 127, 128):
+                idx = order[i:i + 128]
+                net.fit_batch_async(x[idx], y[idx])
+        warm = net.evaluate(xt, test.labels).accuracy()
+        net.fit((x, y), epochs=2)   # dispatches to LBFGS (full batch)
+        acc = net.evaluate(xt, test.labels).accuracy()
+        assert acc >= 0.93, f"LBFGS-finetuned digits accuracy {acc:.4f}"
+        assert acc > warm, (acc, warm)
+
+
 class TestRntnSentimentGate:
     """RNTN trained on the bundled labeled review corpus must beat the
     majority class on held-out ROOT sentiment (VERDICT r3 #6; reference

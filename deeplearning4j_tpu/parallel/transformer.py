@@ -51,6 +51,11 @@ class TransformerConfig:
     # this factor, not with n_experts.  0: dense-masked compute (every
     # expert sees every token; exact, no drops — the dispatch oracle).
     moe_capacity_factor: float = 1.25
+    # Switch load-balancing auxiliary loss weight: aux = E * sum_e f_e*P_e
+    # (f_e = dispatch fraction, P_e = mean router prob).  Without it
+    # top-1 routing collapses onto few experts and capacity dispatch
+    # drops most tokens; lm_loss adds moe_aux_weight * mean-over-layers.
+    moe_aux_weight: float = 0.01
     max_len: int = 512
     dtype: str = "float32"
     attn_bias: bool = False     # GPT-2-style q/k/v/o projection biases
@@ -321,16 +326,32 @@ def _moe(p, x, capacity_factor: float = 0.0,
     return _moe_dense(p, x)
 
 
+def _moe_aux_loss(p, x):
+    """Switch Transformer load-balancing loss (PAPERS.md Fedus et al.
+    eq. 4): E * sum_e f_e * P_e over the router's top-1 assignment.
+    Minimized (=1) at a uniform assignment; differentiable through P_e."""
+    logits = jnp.einsum("bsd,de->bse", x, p["gate"])
+    e = p["w1"].shape[0]
+    probs = jax.nn.softmax(logits, axis=-1)               # [B,S,E]
+    choice = jnp.argmax(logits, axis=-1)                  # [B,S]
+    f = jnp.mean(jax.nn.one_hot(choice, e, dtype=x.dtype), axis=(0, 1))
+    pbar = jnp.mean(probs, axis=(0, 1))
+    return e * jnp.sum(f * pbar)
+
+
 def apply(cfg: TransformerConfig, params: dict, tokens: jax.Array,
           mesh: Optional[Mesh] = None, axes: MeshAxes = MeshAxes(),
-          causal: bool = True, train: bool = False) -> jax.Array:
+          causal: bool = True, train: bool = False,
+          return_aux: bool = False):
     """tokens:[B,S] int32 -> logits [B,S,V]. Pass mesh to parallelize.
 
     MoE routing: `train=True` (the lm_loss path) uses capacity-based
     dispatch — FLOP-saving but drops overflow tokens, so logits can
     depend on batch composition.  The inference default is the exact
     dense-masked path, keeping scoring deterministic per sequence and
-    bit-compatible with the KV-cached `generation.decode_step`."""
+    bit-compatible with the KV-cached `generation.decode_step`.
+    `return_aux=True` additionally returns the mean-over-layers Switch
+    load-balancing loss (0 for dense configs)."""
 
     def constrain(a):
         if mesh is None:
@@ -345,26 +366,42 @@ def apply(cfg: TransformerConfig, params: dict, tokens: jax.Array,
                       mesh, axes, causal)
         x = constrain(x)
         h = _layer_norm(layer["ln2"], x)
-        x = x + (_moe(layer["moe"], h, cf, mesh, axes)
-                 if "moe" in layer else _mlp(layer["mlp"], h))
-        return constrain(x)
+        if "moe" in layer:
+            x = x + _moe(layer["moe"], h, cf, mesh, axes)
+            aux = _moe_aux_loss(layer["moe"], h)
+        else:
+            x = x + _mlp(layer["mlp"], h)
+            aux = jnp.zeros((), x.dtype)
+        return constrain(x), aux
 
     if cfg.remat:
         block = jax.checkpoint(block)
     x = params["embed"][tokens] + params["pos"][None, :tokens.shape[1], :]
     x = constrain(x)
+    auxs = []
     for layer in params["layers"]:
-        x = block(layer, x)
+        x, aux = block(layer, x)
+        auxs.append(aux)
     x = _layer_norm(params["ln_f"], x)
-    return jnp.einsum("bsd,dv->bsv", x, lm_head(params))
+    logits = jnp.einsum("bsd,dv->bsv", x, lm_head(params))
+    if return_aux:
+        return logits, jnp.mean(jnp.stack(auxs))
+    return logits
 
 
 def lm_loss(cfg: TransformerConfig, params: dict, tokens: jax.Array,
             targets: jax.Array, mesh: Optional[Mesh] = None,
             axes: MeshAxes = MeshAxes()) -> jax.Array:
     """Mean next-token cross-entropy over the full batch (training mode:
-    MoE layers route with capacity-based dispatch)."""
-    logits = apply(cfg, params, tokens, mesh, axes, train=True)
+    MoE layers route with capacity-based dispatch + the Switch
+    load-balancing auxiliary loss weighted by cfg.moe_aux_weight)."""
+    use_aux = bool(cfg.n_experts) and cfg.moe_aux_weight > 0
+    out = apply(cfg, params, tokens, mesh, axes, train=True,
+                return_aux=use_aux)
+    logits, aux = out if use_aux else (out, None)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    loss = jnp.mean(nll)
+    if use_aux:
+        loss = loss + cfg.moe_aux_weight * aux.astype(loss.dtype)
+    return loss

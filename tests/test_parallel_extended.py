@@ -208,6 +208,42 @@ class TestMoEDispatch:
         d16 = flops(dense, self._moe_params(16), x)
         assert d16 > 3.0 * d4, (d4, d16)  # the oracle DOES scale with E
 
+    def test_aux_load_balance_loss(self):
+        """Switch aux loss: 1 at a perfectly balanced assignment, larger
+        when routing collapses; lm_loss adds exactly moe_aux_weight * aux
+        in training mode."""
+        import dataclasses
+
+        e, d = 4, 8
+        p = self._moe_params(e, d=d, f=16)
+        # uniform gate -> balanced-ish; zero gate weights = exact uniform
+        p_uni = dict(p, gate=jnp.zeros((d, e)))
+        x = jnp.asarray(np.random.default_rng(5).standard_normal((2, 16, d)),
+                        jnp.float32)
+        # argmax over identical logits picks expert 0 for every token:
+        # f=(1,0,0,0), P uniform -> aux = E * (1/E) = 1
+        assert np.isclose(float(tfm._moe_aux_loss(p_uni, x)), 1.0)
+        # fully concentrated routing: all-ones inputs + gate favoring
+        # expert 0 -> f=(1,0,0,0), P_0 ~ 1 -> aux ~ E
+        p_hot = dict(p, gate=jnp.zeros((d, e)).at[:, 0].set(10.0))
+        x_ones = jnp.ones((2, 16, d), jnp.float32)
+        aux_hot = float(tfm._moe_aux_loss(p_hot, x_ones))
+        assert aux_hot > 0.9 * e  # far above the balanced value of 1
+
+        cfg = tfm.TransformerConfig(vocab_size=31, d_model=16, n_heads=4,
+                                    n_layers=1, d_ff=32, n_experts=4,
+                                    max_len=16, moe_aux_weight=0.5)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(2))
+        tokens = jnp.asarray(
+            np.random.default_rng(6).integers(0, 31, (2, 8)), jnp.int32)
+        targets = jnp.roll(tokens, -1, axis=1)
+        with_aux = float(tfm.lm_loss(cfg, params, tokens, targets))
+        no_aux = float(tfm.lm_loss(
+            dataclasses.replace(cfg, moe_aux_weight=0.0), params, tokens,
+            targets))
+        _, aux = tfm.apply(cfg, params, tokens, train=True, return_aux=True)
+        assert np.isclose(with_aux - no_aux, 0.5 * float(aux), atol=1e-6)
+
     def test_apply_uses_dispatch_under_mesh(self):
         """Full model equivalence in TRAIN mode (dispatch active): apply()
         must agree between mesh (GSPMD dp/sp/tp over 8 devices) and single

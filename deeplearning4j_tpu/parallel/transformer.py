@@ -126,8 +126,14 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:
                 "b2": jnp.zeros((d,), dt),
             }
         layers.append(layer)
+    # Tied configs: the embedding IS the output projection, so it must
+    # carry the head's 1/sqrt(d) scale or initial logits blow up to
+    # std ~sqrt(d) (initial loss ~70 instead of ln V).  The first block
+    # layer-norms its input, so the smaller input-embedding scale is
+    # otherwise inert.
     out = {
-        "embed": dense(next(keys), (cfg.vocab_size, d), 1),
+        "embed": dense(next(keys), (cfg.vocab_size, d),
+                       d if cfg.tie_embeddings else 1),
         "pos": dense(next(keys), (cfg.max_len, d), 1) * 0.02,
         "ln_f": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
         "layers": layers,

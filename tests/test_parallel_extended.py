@@ -370,6 +370,11 @@ class TestFlagshipTrainingPath:
         logits, _ = decode_step(cfg, params, cache, tokens[:, 0])
         np.testing.assert_allclose(np.asarray(logits),
                                    np.asarray(got)[:, 0], atol=2e-4)
+        # tied init must keep initial logits at head scale: loss ~ ln V,
+        # not ln V + O(sqrt(d)) (the tied-embedding scale trap)
+        targets = jnp.roll(tokens, -1, axis=1)
+        loss0 = float(tfm.lm_loss(cfg, params, tokens, targets))
+        assert loss0 < 2.0 * np.log(cfg.vocab_size), loss0
 
     def test_remat_is_numerically_transparent(self):
         tokens = jnp.asarray(

@@ -222,32 +222,30 @@ def cmd_lm(args) -> int:
             raise SystemExit(f"input too short for -seq {S}")
         import dataclasses
 
+        from deeplearning4j_tpu.parallel.hybrid import (
+            _master_f32,
+            make_accum_train_step,
+        )
+
         # Mixed precision, not pure bf16: params/updates stay float32
         # (a bf16 `w - lr*g` swallows updates below ~0.4% of the weight
         # and training silently stalls); the forward casts to bf16 on
         # TPU so the MXU runs at its native rate.
         on_tpu = jax.default_backend() == "tpu"
-        cfg = tfm.TransformerConfig(
-            vocab_size=256, d_model=args.d_model, n_heads=args.heads,
-            n_layers=args.layers, d_ff=4 * args.d_model, max_len=S)
-        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        if args.preset == "gpt2-small":
+            # Byte-level flagship: 768/12/12, tied embeddings, per-block
+            # remat; -seq defaults are honored (S1024 recommended).
+            cfg = dataclasses.replace(
+                tfm.gpt2_small(max_len=S, dtype="float32"), vocab_size=256)
+        else:
+            cfg = tfm.TransformerConfig(
+                vocab_size=256, d_model=args.d_model, n_heads=args.heads,
+                n_layers=args.layers, d_ff=4 * args.d_model, max_len=S)
+        params = _master_f32(tfm.init_params(cfg, jax.random.PRNGKey(0)))
         compute_cfg = (dataclasses.replace(cfg, dtype="bfloat16")
                        if on_tpu else cfg)
-
-        def _cast(tree, dt):
-            return jax.tree_util.tree_map(
-                lambda a: a.astype(dt)
-                if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
-
-        @jax.jit
-        def step(p, tokens, targets):
-            def loss_fn(q):
-                qc = (_cast(q, jnp.bfloat16) if on_tpu else q)
-                return tfm.lm_loss(compute_cfg, qc, tokens, targets)
-
-            loss, grads = jax.value_and_grad(loss_fn)(p)
-            return jax.tree_util.tree_map(
-                lambda w, g: w - args.lr * g, p, grads), loss
+        step = make_accum_train_step(compute_cfg, lr=args.lr,
+                                     accum=args.accum)
 
         spmd_mesh = None
         if args.runtime == "spmd":
@@ -270,6 +268,9 @@ def cmd_lm(args) -> int:
                       f"({n}-device shards; `dl4j train` pads likewise)")
                 B = rounded
 
+        if args.accum > 1 and B % args.accum:
+            raise SystemExit(f"-batch {B} (after any spmd rounding) must "
+                             f"be divisible by -accum {args.accum}")
         rng = np.random.default_rng(0)
         steps = max(1, args.epochs * (len(ids) // max(B * S, 1)))
         t0, loss = time.time(), None
@@ -380,6 +381,13 @@ def build_parser() -> argparse.ArgumentParser:
     p_lm.add_argument("-epochs", "--epochs", type=int, default=1)
     p_lm.add_argument("-batch", "--batch", type=int, default=8)
     p_lm.add_argument("-seq", "--seq", type=int, default=128)
+    p_lm.add_argument("-preset", "--preset", choices=["gpt2-small"],
+                      default=None,
+                      help="flagship config preset (768/12/12, tied "
+                           "embeddings, remat) overriding -d-model/"
+                           "-layers/-heads")
+    p_lm.add_argument("-accum", "--accum", type=int, default=1,
+                      help="gradient-accumulation microbatches per step")
     p_lm.add_argument("-d-model", "--d-model", dest="d_model", type=int,
                       default=128)
     p_lm.add_argument("-layers", "--layers", type=int, default=2)

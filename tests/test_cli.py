@@ -139,6 +139,26 @@ def test_lm_train_save_generate(tmp_path, capsys):
     assert sampled.startswith("the quick") and len(sampled) > len("the quick")
 
 
+def test_lm_accum_trains_and_generates(tmp_path, capsys):
+    """`dl4j lm -accum k`: gradient accumulation through
+    make_accum_train_step; training completes, saves, generates."""
+    text = tmp_path / "corpus.txt"
+    text.write_text("to be or not to be that is the question. " * 40)
+    out = tmp_path / "lm"
+    rc = main(["lm", "-input", str(text), "-output", str(out),
+               "-epochs", "2", "-batch", "4", "-seq", "32", "-accum", "2",
+               "-d-model", "32", "-layers", "1", "-heads", "2"])
+    assert rc == 0
+    rc = main(["lm", "-output", str(out), "-generate", "to be",
+               "-max-new", "6", "-temperature", "0"])
+    assert rc == 0
+    # indivisible accum fails fast with a clear message
+    with pytest.raises(SystemExit, match="divisible"):
+        main(["lm", "-input", str(text), "-output", str(out),
+              "-epochs", "1", "-batch", "4", "-seq", "32", "-accum", "3",
+              "-d-model", "32", "-layers", "1", "-heads", "2"])
+
+
 def test_lm_spmd_runtime_trains_data_parallel(tmp_path, capsys):
     """`dl4j lm -runtime spmd`: the batch shards over the 8-device mesh
     (GSPMD inserts the gradient allreduce); training completes and the

@@ -80,8 +80,8 @@ def decode_step(cfg: TransformerConfig, params: dict, cache: dict,
         # Dense-masked MoE (capacity_factor=0): exact, no drops — matches
         # apply()'s inference default, preserving this module's
         # cache-path == full-recompute contract for MoE configs.
-        x = x + (_moe(layer["moe"], h) if "moe" in layer
-                 else _mlp(layer["mlp"], h))
+        x = x + (_moe(layer["moe"], h, top_k=cfg.moe_top_k)
+                 if "moe" in layer else _mlp(layer["mlp"], h))
     x = _layer_norm(params["ln_f"], x)
     logits = jnp.einsum("bsd,dv->bsv", x, lm_head(params))[:, 0]
     new_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs), "pos": pos + 1}

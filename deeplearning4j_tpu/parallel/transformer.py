@@ -44,7 +44,10 @@ class TransformerConfig:
     n_heads: int = 8
     n_layers: int = 2
     d_ff: int = 256
-    n_experts: int = 0          # 0 = dense MLP; >0 = top-1 MoE
+    n_experts: int = 0          # 0 = dense MLP; >0 = MoE
+    # Experts per token: 1 = Switch, 2 = GShard-style top-2 (gate weights
+    # renormalized over the chosen experts).
+    moe_top_k: int = 1
     # Per-expert buffer size as a multiple of tokens/n_experts (Switch
     # Transformer capacity factor).  >0: capacity-based dispatch — each
     # expert computes ONLY its gathered buffer, so MoE FLOPs scale with
@@ -67,6 +70,12 @@ class TransformerConfig:
     # (jax.checkpoint): activation memory drops from O(L*B*S*d) to the
     # block boundaries, the standard trade for long-context training.
     remat: bool = False
+
+    def __post_init__(self):
+        if self.n_experts and not (1 <= self.moe_top_k <= self.n_experts):
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} must be in [1, "
+                f"n_experts={self.n_experts}]")
 
     @property
     def head_dim(self) -> int:
@@ -255,17 +264,27 @@ def _mlp(p, x):
     return jnp.einsum("bsf,fd->bsd", h, p["w2"]) + p["b2"]
 
 
-def _moe_dense(p, x):
-    """Top-1 MoE, dense-masked compute: every expert sees every token and
+def _router_weights(probs, top_k):
+    """(top_idx, weights) [..., k].  k=1: the Switch top-1 router prob
+    itself; k>1: GShard-style renormalization over the chosen experts."""
+    top_p, top_idx = lax.top_k(probs, top_k)
+    if top_k > 1:
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    return top_idx, top_p
+
+
+def _moe_dense(p, x, top_k: int = 1):
+    """Top-k MoE, dense-masked compute: every expert sees every token and
     the combine weight zeroes non-routed pairs — exact (no capacity
     drops) but O(n_experts) FLOPs.  Kept as the correctness ORACLE for
-    `_moe_dispatch`; select with cfg.moe_capacity_factor = 0."""
+    `_moe_dispatch` and as the exact inference path; select with
+    cfg.moe_capacity_factor = 0."""
     logits = jnp.einsum("bsd,de->bse", x, p["gate"])
-    choice = jnp.argmax(logits, axis=-1)                       # [B,S]
     gate_w = jax.nn.softmax(logits, axis=-1)                   # [B,S,E]
     e = p["w1"].shape[0]
-    onehot = jax.nn.one_hot(choice, e, dtype=x.dtype)          # [B,S,E]
-    combine = gate_w * onehot                                  # [B,S,E]
+    top_idx, w = _router_weights(gate_w, top_k)                # [B,S,k]
+    combine = jnp.sum(
+        w[..., None] * jax.nn.one_hot(top_idx, e, dtype=x.dtype), axis=-2)
     h = jax.nn.gelu(jnp.einsum("bsd,edf->ebsf", x, p["w1"])
                     + p["b1"][:, None, None, :])
     y = jnp.einsum("ebsf,efd->ebsd", h, p["w2"]) + p["b2"][:, None, None, :]
@@ -274,15 +293,17 @@ def _moe_dense(p, x):
 
 def _moe_dispatch(p, x, capacity_factor: float,
                   mesh: Optional[Mesh] = None,
-                  axes: MeshAxes = MeshAxes()):
-    """Capacity-based top-1 dispatch (the Switch Transformer routing rule,
-    PAPERS.md Fedus et al.): tokens are scattered into a static
-    [E, C, d] buffer with C = ceil(capacity_factor * tokens / E), each
-    expert computes ONLY its buffer, outputs gather back weighted by the
-    router probability.  Expert FLOPs therefore scale with the capacity
-    factor, NOT with n_experts.  Tokens past an expert's capacity (in
-    batch-major order) contribute nothing to the branch — identity via
-    the surrounding residual, the standard Switch drop rule.
+                  axes: MeshAxes = MeshAxes(), top_k: int = 1):
+    """Capacity-based top-k dispatch (Switch routing at k=1, GShard-style
+    top-2 at k=2; PAPERS.md Fedus et al.): the N*k (token, expert)
+    assignments are scattered into a static [E, C, d] buffer with
+    C = ceil(capacity_factor * N * k / E), each expert computes ONLY its
+    buffer, outputs gather back weighted by the router weight and sum
+    over a token's k assignments.  Expert FLOPs therefore scale with the
+    capacity factor, NOT with n_experts.  Assignments past an expert's
+    capacity (token-major priority: a token's second choice ranks after
+    its first) contribute nothing — identity via the surrounding
+    residual, the standard drop rule.
 
     Static shapes throughout (scatter/gather via `.at[]` / advanced
     indexing), so the routing is jit/GSPMD-clean; with a mesh the buffer
@@ -291,20 +312,23 @@ def _moe_dispatch(p, x, capacity_factor: float,
     B, S, d = x.shape
     E = p["w1"].shape[0]
     N = B * S
-    C = max(1, min(N, int(math.ceil(capacity_factor * N / E))))  # static
+    A = N * top_k                    # total (token, expert) assignments
+    C = max(1, min(A, int(math.ceil(capacity_factor * A / E))))  # static
     xf = x.reshape(N, d)
     logits = xf @ p["gate"]                                    # [N,E]
     gate_w = jax.nn.softmax(logits, axis=-1)
-    choice = jnp.argmax(logits, axis=-1)                       # [N]
-    top_w = jnp.take_along_axis(gate_w, choice[:, None], 1)[:, 0]
-    onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)
-    # 0-based slot of each token within its expert's buffer (batch-major
-    # priority), C and above = overflow.
+    top_idx, top_w = _router_weights(gate_w, top_k)            # [N,k]
+    e_flat = top_idx.reshape(-1)                               # [A]
+    w_flat = top_w.reshape(-1)
+    onehot = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)
+    # 0-based slot of each assignment within its expert's buffer
+    # (token-major priority), C and above = overflow.
     slot = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=1) - 1
-    keep = (slot < C).astype(x.dtype)                          # [N]
+    keep = (slot < C).astype(x.dtype)                          # [A]
     slot = jnp.clip(slot, 0, C - 1)
-    buf = jnp.zeros((E, C, d), x.dtype).at[choice, slot].add(
-        xf * keep[:, None])
+    x_rep = jnp.repeat(xf, top_k, axis=0)                      # [A, d]
+    buf = jnp.zeros((E, C, d), x.dtype).at[e_flat, slot].add(
+        x_rep * keep[:, None])
 
     def constrain(a):
         if mesh is None:
@@ -317,19 +341,20 @@ def _moe_dispatch(p, x, capacity_factor: float,
                     + p["b1"][:, None, :])
     y = jnp.einsum("ecf,efd->ecd", h, p["w2"]) + p["b2"][:, None, :]
     y = constrain(y)
-    # Each kept token owns its slot exclusively; dropped tokens read a
+    # Each kept assignment owns its slot exclusively; dropped ones read a
     # foreign slot but are zeroed by `keep`.
-    out = y[choice, slot] * (top_w * keep)[:, None]
-    return out.reshape(B, S, d)
+    out = y[e_flat, slot] * (w_flat * keep)[:, None]           # [A, d]
+    return jnp.sum(out.reshape(N, top_k, d), axis=1).reshape(B, S, d)
 
 
 def _moe(p, x, capacity_factor: float = 0.0,
-         mesh: Optional[Mesh] = None, axes: MeshAxes = MeshAxes()):
+         mesh: Optional[Mesh] = None, axes: MeshAxes = MeshAxes(),
+         top_k: int = 1):
     """MoE block: capacity-based dispatch when capacity_factor > 0
     (the FLOP-saving default), dense-masked oracle otherwise."""
     if capacity_factor > 0:
-        return _moe_dispatch(p, x, capacity_factor, mesh, axes)
-    return _moe_dense(p, x)
+        return _moe_dispatch(p, x, capacity_factor, mesh, axes, top_k)
+    return _moe_dense(p, x, top_k)
 
 
 def _moe_aux_loss(p, x):
@@ -373,7 +398,7 @@ def apply(cfg: TransformerConfig, params: dict, tokens: jax.Array,
         x = constrain(x)
         h = _layer_norm(layer["ln2"], x)
         if "moe" in layer:
-            x = x + _moe(layer["moe"], h, cf, mesh, axes)
+            x = x + _moe(layer["moe"], h, cf, mesh, axes, cfg.moe_top_k)
             aux = _moe_aux_loss(layer["moe"], h)
         else:
             x = x + _mlp(layer["mlp"], h)

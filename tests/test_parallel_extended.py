@@ -208,6 +208,67 @@ class TestMoEDispatch:
         d16 = flops(dense, self._moe_params(16), x)
         assert d16 > 3.0 * d4, (d4, d16)  # the oracle DOES scale with E
 
+    def test_top_k_config_validation(self):
+        with pytest.raises(ValueError, match="moe_top_k"):
+            tfm.TransformerConfig(n_experts=4, moe_top_k=0)
+        with pytest.raises(ValueError, match="moe_top_k"):
+            tfm.TransformerConfig(n_experts=4, moe_top_k=8)
+        tfm.TransformerConfig(n_experts=0, moe_top_k=1)  # dense: unused
+
+    def test_top2_dispatch_matches_dense_oracle_at_full_capacity(self):
+        """GShard-style top-2: dispatch == dense oracle when no
+        assignment is dropped (values AND gradients), and top-2 output
+        is a renormalized two-expert blend (differs from top-1)."""
+        e = 4
+        p = self._moe_params(e, seed=7)
+        x = jnp.asarray(np.random.default_rng(7).standard_normal((2, 8, 16)),
+                        jnp.float32)
+        got = tfm._moe_dispatch(p, x, capacity_factor=float(e), top_k=2)
+        want = tfm._moe_dense(p, x, top_k=2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+        one = tfm._moe_dense(p, x, top_k=1)
+        assert not np.allclose(np.asarray(want), np.asarray(one))
+        g_got = jax.grad(lambda q: jnp.sum(
+            tfm._moe_dispatch(q, x, float(e), top_k=2) ** 2))(p)
+        g_want = jax.grad(lambda q: jnp.sum(
+            tfm._moe_dense(q, x, top_k=2) ** 2))(p)
+        for a, b in zip(jax.tree_util.tree_leaves(g_got),
+                        jax.tree_util.tree_leaves(g_want)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4)
+
+    def test_top2_full_model_trains_and_decodes_consistently(self):
+        """moe_top_k=2 end to end: lm_loss trains (finite, decreasing)
+        and the decode contract holds (dense top-2 inference both
+        sides)."""
+        from deeplearning4j_tpu.parallel.generation import (
+            decode_step, init_cache)
+
+        cfg = tfm.TransformerConfig(vocab_size=31, d_model=16, n_heads=4,
+                                    n_layers=1, d_ff=32, n_experts=4,
+                                    moe_top_k=2, max_len=16)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(3))
+        rng = np.random.default_rng(8)
+        tokens = jnp.asarray(rng.integers(0, 31, (2, 10)), jnp.int32)
+        targets = jnp.roll(tokens, -1, axis=1)
+        losses = []
+        p = params
+        step = jax.jit(lambda q, t, g: (
+            _sgd_tree(q, jax.grad(
+                lambda z: tfm.lm_loss(cfg, z, t, g))(q), 0.1),
+            tfm.lm_loss(cfg, q, t, g)))
+        for _ in range(8):
+            p, l = step(p, tokens, targets)
+            losses.append(float(l))
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+        full = np.asarray(tfm.apply(cfg, p, tokens))
+        cache = init_cache(cfg, 2)
+        for t in range(tokens.shape[1]):
+            logits, cache = decode_step(cfg, p, cache, tokens[:, t])
+            np.testing.assert_allclose(np.asarray(logits), full[:, t],
+                                       atol=2e-4)
+
     def test_aux_load_balance_loss(self):
         """Switch aux loss: 1 at a perfectly balanced assignment, larger
         when routing collapses; lm_loss adds exactly moe_aux_weight * aux

@@ -30,7 +30,8 @@ from jax import lax
 
 
 def gpipe_apply(stage_fn: Callable, stage_params, x_local: jax.Array,
-                axis_name: str, n_microbatches: int) -> jax.Array:
+                axis_name: str, n_microbatches: int,
+                remat_stage: bool = True) -> jax.Array:
     """Run the microbatch pipeline over this stage's LOCAL input share.
 
     stage_fn(local_params, x) -> y, same activation shape in and out.
@@ -42,6 +43,11 @@ def gpipe_apply(stage_fn: Callable, stage_params, x_local: jax.Array,
     padding and are never injected into the pipeline.
     Returns [K, mb, ...]: this stage's share of the outputs in the same
     blocked layout (padding slots stay zero).
+
+    remat_stage (default True): rematerialize the per-tick stage forward
+    in the backward pass (jax.checkpoint) — the scan then stashes only
+    each tick's O(mb) input instead of every intermediate inside
+    stage_fn, the standard GPipe memory discipline.
     """
     # Under shard_map, psum of a literal is the axis size as a concrete
     # int at trace time — usable for static perm lists and scan lengths.
@@ -57,6 +63,7 @@ def gpipe_apply(stage_fn: Callable, stage_params, x_local: jax.Array,
     local_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     act_shape = x_local.shape[1:]
+    run_stage = (jax.checkpoint(stage_fn) if remat_stage else stage_fn)
 
     def tick(carry, t):
         incoming, outputs = carry
@@ -73,7 +80,7 @@ def gpipe_apply(stage_fn: Callable, stage_params, x_local: jax.Array,
         # ticks skip the forward entirely (runtime branch).
         active = jnp.logical_and(t >= stage, t < stage + m)
         y = lax.cond(active,
-                     lambda a: stage_fn(local_params, a),
+                     lambda a: run_stage(local_params, a),
                      lambda a: a, x_in)
         # The last stage's result is microbatch out_idx = t - (P - 1);
         # broadcast it and let the owner of that output slot bank it.

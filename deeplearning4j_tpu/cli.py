@@ -298,6 +298,30 @@ def cmd_lm(args) -> int:
             raise SystemExit(f"no -input and no saved LM at {out}")
         cfg, params = load()
 
+    if args.eval is not None:
+        # Held-out byte-level perplexity: mean NLL over non-overlapping
+        # cfg.max_len windows, exp() at the end (teacher forcing via the
+        # same lm_loss the trainer minimizes, inference routing).
+        ev_ids = np.frombuffer(pathlib.Path(args.eval).read_bytes(),
+                               np.uint8).astype(np.int32)
+        S_ev = cfg.max_len
+        if len(ev_ids) < S_ev + 1:
+            raise SystemExit(f"-eval file too short for seq_len {S_ev}")
+        n_win = min((len(ev_ids) - 1) // S_ev, 64)
+        tok = np.stack([ev_ids[i * S_ev:(i + 1) * S_ev]
+                        for i in range(n_win)])
+        tgt = np.stack([ev_ids[i * S_ev + 1:(i + 1) * S_ev + 1]
+                        for i in range(n_win)])
+        nll_fn = jax.jit(lambda p, t, g: tfm.lm_loss(cfg, p, t, g))
+        # batch windows to bound memory; mean of per-window means is the
+        # global mean (equal window sizes)
+        nlls = [float(nll_fn(params, jnp.asarray(tok[i:i + 8]),
+                             jnp.asarray(tgt[i:i + 8])))
+                for i in range(0, n_win, 8)]
+        nll = float(np.mean(nlls))
+        print(f"eval: {n_win} windows x {S_ev} bytes, "
+              f"nll {nll:.4f}, perplexity {float(np.exp(nll)):.2f}")
+
     if args.generate is not None:
         prompt = np.frombuffer(
             (args.generate or "\n").encode(), np.uint8).astype(np.int32)
@@ -306,11 +330,20 @@ def cmd_lm(args) -> int:
                 f"prompt ({len(prompt)} bytes) + -max-new ({args.max_new}) "
                 f"exceeds the model's context ({cfg.max_len}, set by -seq "
                 f"at training time) — shorten one of them")
-        toks = generate(cfg, params, prompt[None, :],
-                        max_new_tokens=args.max_new,
-                        temperature=args.temperature,
-                        top_k=args.top_k, top_p=args.top_p,
-                        rng=jax.random.PRNGKey(args.gen_seed))
+        if args.beam > 1:
+            from deeplearning4j_tpu.parallel.generation import beam_search
+
+            toks, scores = beam_search(cfg, params, prompt[None, :],
+                                       max_new_tokens=args.max_new,
+                                       beam_size=args.beam)
+            print(f"beam[{args.beam}] log-prob "
+                  f"{float(scores[0]):.3f}", file=sys.stderr)
+        else:
+            toks = generate(cfg, params, prompt[None, :],
+                            max_new_tokens=args.max_new,
+                            temperature=args.temperature,
+                            top_k=args.top_k, top_p=args.top_p,
+                            rng=jax.random.PRNGKey(args.gen_seed))
         text = bytes(np.asarray(toks[0], np.uint8)).decode(
             errors="replace")
         print(text)
@@ -404,6 +437,11 @@ def build_parser() -> argparse.ArgumentParser:
                       default=0, help="truncate sampling to k best tokens")
     p_lm.add_argument("-top-p", "--top-p", dest="top_p", type=float,
                       default=1.0, help="nucleus sampling mass")
+    p_lm.add_argument("-beam", "--beam", type=int, default=1,
+                      help="beam-search width for -generate (1 = off)")
+    p_lm.add_argument("-eval", "--eval", default=None,
+                      help="report byte-level perplexity on this held-out "
+                           "text file")
     p_lm.add_argument("-gen-seed", "--gen-seed", dest="gen_seed", type=int,
                       default=0)
     p_lm.add_argument("-runtime", "--runtime",

@@ -295,7 +295,8 @@ def _moe_dispatch(p, x, capacity_factor: float,
                   mesh: Optional[Mesh] = None,
                   axes: MeshAxes = MeshAxes(), top_k: int = 1):
     """Capacity-based top-k dispatch (Switch routing at k=1, GShard-style
-    top-2 at k=2; PAPERS.md Fedus et al.): the N*k (token, expert)
+    top-2 at k=2; Switch Transformer, Fedus et al. 2021 / GShard, Lepikhin
+    et al. 2020 — public formulations): the N*k (token, expert)
     assignments are scattered into a static [E, C, d] buffer with
     C = ceil(capacity_factor * N * k / E), each expert computes ONLY its
     buffer, outputs gather back weighted by the router weight and sum
@@ -358,7 +359,7 @@ def _moe(p, x, capacity_factor: float = 0.0,
 
 
 def _moe_aux_loss(p, x):
-    """Switch Transformer load-balancing loss (PAPERS.md Fedus et al.
+    """Switch Transformer load-balancing loss (Fedus et al. 2021,
     eq. 4): E * sum_e f_e * P_e over the router's top-1 assignment.
     Minimized (=1) at a uniform assignment; differentiable through P_e."""
     logits = jnp.einsum("bsd,de->bse", x, p["gate"])

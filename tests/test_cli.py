@@ -2,6 +2,7 @@
 irisSvmLight.txt + a JSON model config; same flow here."""
 
 import json
+import re
 
 import numpy as np
 import pytest
@@ -157,6 +158,31 @@ def test_lm_accum_trains_and_generates(tmp_path, capsys):
         main(["lm", "-input", str(text), "-output", str(out),
               "-epochs", "1", "-batch", "4", "-seq", "32", "-accum", "3",
               "-d-model", "32", "-layers", "1", "-heads", "2"])
+
+
+def test_lm_eval_perplexity_and_beam_generate(tmp_path, capsys):
+    """`dl4j lm -eval`: held-out byte perplexity; `-beam k`: beam-search
+    decoding from the saved model."""
+    text = tmp_path / "corpus.txt"
+    text.write_text("all work and no play makes jack a dull boy. " * 40)
+    held = tmp_path / "held.txt"  # same distribution: ppl well below uniform
+    held.write_text("all work and no play makes jack a dull boy. " * 20)
+    out = tmp_path / "lm"
+    rc = main(["lm", "-input", str(text), "-output", str(out),
+               "-epochs", "20", "-batch", "8", "-seq", "32", "-lr", "0.01",
+               "-d-model", "32", "-layers", "1", "-heads", "2"])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(["lm", "-output", str(out), "-eval", str(held)])
+    assert rc == 0
+    stdout = capsys.readouterr().out
+    m = re.search(r"perplexity (\d+\.?\d*)", stdout)
+    # trained model: far below the uniform-byte 256 (measured ~18)
+    assert m and 1.0 < float(m.group(1)) < 100.0
+    rc = main(["lm", "-output", str(out), "-generate", "all work",
+               "-max-new", "6", "-beam", "2"])
+    assert rc == 0
+    assert capsys.readouterr().out.startswith("all work")
 
 
 def test_lm_spmd_runtime_trains_data_parallel(tmp_path, capsys):

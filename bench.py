@@ -512,16 +512,20 @@ def bench_gpt2() -> dict:
     params = _master_f32(tfm.init_params(cfg, jax.random.PRNGKey(0)))
     n_params = sum(int(np.prod(np.shape(x)))
                    for x in jax.tree_util.tree_leaves(params))
-    step = make_accum_train_step(cfg, lr=1e-3, accum=accum)
+    # Adam, not SGD: the realistic pretraining step (its state update is
+    # part of what the MFU row should honestly include).
+    step, init_state = make_accum_train_step(cfg, lr=1e-3, accum=accum,
+                                             updater="adam")
     rng = np.random.default_rng(0)
     tokens, targets = _staged(
         rng.integers(0, cfg.vocab_size, (b_global, S)).astype(np.int32),
         rng.integers(0, cfg.vocab_size, (b_global, S)).astype(np.int32))
 
-    state = {"p": params}
+    state = {"p": params, "o": init_state(params)}
 
     def one():
-        state["p"], loss = step(state["p"], tokens, targets)
+        state["p"], state["o"], loss = step(state["p"], state["o"],
+                                            tokens, targets)
         return loss
 
     sec = _time_steps(one, 2, steps)

@@ -244,8 +244,10 @@ def cmd_lm(args) -> int:
         params = _master_f32(tfm.init_params(cfg, jax.random.PRNGKey(0)))
         compute_cfg = (dataclasses.replace(cfg, dtype="bfloat16")
                        if on_tpu else cfg)
-        step = make_accum_train_step(compute_cfg, lr=args.lr,
-                                     accum=args.accum)
+        step, init_opt = make_accum_train_step(
+            compute_cfg, lr=args.lr, accum=args.accum,
+            updater=args.updater)
+        opt_state = init_opt(params)
 
         spmd_mesh = None
         if args.runtime == "spmd":
@@ -285,7 +287,8 @@ def cmd_lm(args) -> int:
                     print(f"spmd: batch sharded over {n} devices")
             else:
                 tokens, targets = jnp.asarray(tokens), jnp.asarray(targets)
-            params, loss = step(params, tokens, targets)
+            params, opt_state, loss = step(params, opt_state, tokens,
+                                           targets)
             if args.verbose and (k + 1) % 20 == 0:
                 print(f"step {k + 1}/{steps} loss {float(loss):.4f}")
         tok_rate = steps * B * S / max(time.time() - t0, 1e-9)
@@ -426,6 +429,10 @@ def build_parser() -> argparse.ArgumentParser:
     p_lm.add_argument("-layers", "--layers", type=int, default=2)
     p_lm.add_argument("-heads", "--heads", type=int, default=4)
     p_lm.add_argument("-lr", "--lr", type=float, default=3e-3)
+    p_lm.add_argument("-updater", "--updater", default="adam",
+                      choices=["sgd", "adam", "adamw", "lion", "rmsprop",
+                               "adagrad", "nesterovs"],
+                      help="optimizer for lm training (default adam)")
     p_lm.add_argument("-generate", "--generate", nargs="?", const="",
                       default=None, metavar="PROMPT",
                       help="sample after training/loading (optional prompt)")

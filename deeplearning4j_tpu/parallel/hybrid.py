@@ -70,23 +70,38 @@ def place_params(mesh: Mesh, tree, spec_tree):
 
 
 def make_accum_train_step(cfg: tfm.TransformerConfig, lr: float = 1e-3,
-                          accum: int = 1):
+                          accum: int = 1, updater: str = "sgd",
+                          clip_norm: float = None,
+                          weight_decay: float = 0.0):
     """Single-chip flagship train step: donated f32 master params, bf16
     compute when the config says so, gradient accumulation over `accum`
     sequential microbatches via lax.scan (activation memory of ONE
-    microbatch; pair with cfg.remat for long sequences).
+    microbatch; pair with cfg.remat for long sequences).  Any updater
+    from ops.updaters ('adam' is the realistic pretraining choice; the
+    optimizer state lives in f32 beside the master params).
 
-    step(params, tokens, targets) -> (params, mean_loss); tokens/targets
-    are [accum * mb, S].  This is the bench_gpt2 / GPT-2-small-class
-    training path (VERDICT r4 demand #2)."""
+    Returns (step, init_state):
+      init_state(params) -> opt_state
+      step(params, opt_state, tokens, targets) -> (params, opt_state,
+      mean_loss); tokens/targets are [accum * mb, S].
+    This is the bench_gpt2 / GPT-2-small-class training path."""
+    from deeplearning4j_tpu.ops.updaters import (
+        UpdaterConfig,
+        apply_updates,
+        make_updater,
+    )
+
     compute_dtype = jnp.dtype(cfg.dtype)
+    transform = make_updater(UpdaterConfig(
+        updater=updater, learning_rate=lr, clip_norm=clip_norm,
+        weight_decay=weight_decay, epsilon=1e-8))
 
     def loss_fn(p32, tok, tgt):
         p = (_cast_floating(p32, compute_dtype)
              if compute_dtype != jnp.float32 else p32)
         return tfm.lm_loss(cfg, p, tok, tgt)
 
-    def step(params, tokens, targets):
+    def step(params, opt_state, tokens, targets):
         if tokens.shape[0] % accum:
             raise ValueError(
                 f"global batch {tokens.shape[0]} must be divisible by "
@@ -111,9 +126,10 @@ def make_accum_train_step(cfg: tfm.TransformerConfig, lr: float = 1e-3,
                 body, (zeros, jnp.float32(0.0)), (tok_mb, tgt_mb))
             grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
             loss = loss / accum
-        return _sgd_tree(params, grads, lr), loss
+        updates, opt_state = transform.update(grads, opt_state, params)
+        return apply_updates(params, updates), opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0,))
+    return jax.jit(step, donate_argnums=(0, 1)), transform.init
 
 
 class HybridParallelTrainer:

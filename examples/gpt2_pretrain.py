@@ -59,7 +59,9 @@ def main() -> None:
                    for x in jax.tree_util.tree_leaves(params))
     print(f"params: {n_params/1e6:.1f}M  seq {seq}  batch {batch} "
           f"(accum {accum})  dtype {cfg.dtype}")
-    step = make_accum_train_step(cfg, lr=3e-4, accum=accum)
+    step, init_state = make_accum_train_step(cfg, lr=3e-4, accum=accum,
+                                             updater="adam")
+    opt_state = init_state(params)
 
     rng = np.random.default_rng(0)
     t0 = time.time()
@@ -67,7 +69,7 @@ def main() -> None:
         starts = rng.integers(0, len(ids) - seq - 1, batch)
         tokens = np.stack([ids[s:s + seq] for s in starts])
         targets = np.stack([ids[s + 1:s + seq + 1] for s in starts])
-        params, loss = step(params, tokens, targets)
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
         if i % 10 == 0 or i == steps - 1:
             print(f"step {i:4d}  loss {float(loss):.4f}  "
                   f"({(i + 1) * batch * seq / (time.time() - t0):,.0f} "

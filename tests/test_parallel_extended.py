@@ -456,7 +456,8 @@ class TestFlagshipTrainingPath:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-6)
 
-    def test_grad_accumulation_matches_full_batch(self):
+    @pytest.mark.parametrize("updater", ["sgd", "adam"])
+    def test_grad_accumulation_matches_full_batch(self, updater):
         from deeplearning4j_tpu.parallel.hybrid import make_accum_train_step
 
         cfg = self._cfg(tie_embeddings=True, remat=True)
@@ -464,15 +465,22 @@ class TestFlagshipTrainingPath:
         tokens = jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32)
         targets = jnp.roll(tokens, -1, axis=1)
         p0 = tfm.init_params(cfg, jax.random.PRNGKey(2))
-        p_full, l_full = make_accum_train_step(cfg, lr=0.1, accum=1)(
-            jax.tree_util.tree_map(jnp.copy, p0), tokens, targets)
-        p_acc, l_acc = make_accum_train_step(cfg, lr=0.1, accum=4)(
-            jax.tree_util.tree_map(jnp.copy, p0), tokens, targets)
+
+        def run(accum):
+            step, init = make_accum_train_step(cfg, lr=0.1, accum=accum,
+                                               updater=updater)
+            p = jax.tree_util.tree_map(jnp.copy, p0)
+            return step(p, init(p), tokens, targets)
+
+        p_full, _, l_full = run(1)
+        p_acc, _, l_acc = run(4)
         np.testing.assert_allclose(float(l_acc), float(l_full), atol=1e-5)
+        # 5e-5: scan-vs-single-sum float reduction order, amplified by
+        # adam's rsqrt on near-zero second moments
         for a, b in zip(jax.tree_util.tree_leaves(p_acc),
                         jax.tree_util.tree_leaves(p_full)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       atol=1e-5)
+                                       atol=5e-5)
 
 
 class TestGPipeMemoryHygiene:

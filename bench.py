@@ -55,10 +55,14 @@ def _enable_persistent_compile_cache() -> None:
     """Persist XLA compiles across processes (BENCH_JAX_CACHE_DIR,
     default /tmp/dl4j_jax_cache).  Strategic for the flaky TPU tunnel:
     a short green window should spend its seconds MEASURING, not
-    recompiling programs an earlier attempt already built."""
+    recompiling programs an earlier attempt already built.  TPU only:
+    CPU AOT cache entries are machine-feature-pinned and XLA warns they
+    can SIGILL when the loading process's feature detection differs."""
     import jax
 
     try:
+        if jax.default_backend() != "tpu":
+            return
         jax.config.update(
             "jax_compilation_cache_dir",
             os.environ.get("BENCH_JAX_CACHE_DIR", "/tmp/dl4j_jax_cache"))

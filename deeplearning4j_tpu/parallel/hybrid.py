@@ -133,11 +133,23 @@ def make_accum_train_step(cfg: tfm.TransformerConfig, lr: float = 1e-3,
 
 
 class HybridParallelTrainer:
-    """dp x sp x tp(+ep) training for the TransformerLM via GSPMD."""
+    """dp x sp x tp(+ep) training for the TransformerLM via GSPMD.
+
+    `updater` selects any ops.updaters transform ('sgd' keeps the
+    historical exact-SGD behavior; 'adam' is the realistic pretraining
+    choice).  Optimizer state is elementwise per parameter, so GSPMD
+    shards it exactly like the parameter it moments."""
 
     def __init__(self, cfg: tfm.TransformerConfig, mesh: Mesh,
                  lr: float = 1e-2, seed: int = 0,
-                 axes: tfm.MeshAxes = tfm.MeshAxes()):
+                 axes: tfm.MeshAxes = tfm.MeshAxes(),
+                 updater: str = "sgd"):
+        from deeplearning4j_tpu.ops.updaters import (
+            UpdaterConfig,
+            apply_updates,
+            make_updater,
+        )
+
         self.cfg = cfg
         self.mesh = mesh
         self.lr = lr
@@ -146,25 +158,30 @@ class HybridParallelTrainer:
         self.params = place_params(
             mesh, _master_f32(tfm.init_params(cfg, jax.random.PRNGKey(seed))),
             self._pspecs)
-        cfg_, lr_, mesh_, axes_ = cfg, lr, mesh, axes
+        transform = make_updater(UpdaterConfig(
+            updater=updater, learning_rate=lr, epsilon=1e-8))
+        self.opt_state = transform.init(self.params)
+        cfg_, mesh_, axes_ = cfg, mesh, axes
         compute_dtype = jnp.dtype(cfg.dtype)
 
-        def step(params, tokens, targets):
+        def step(params, opt_state, tokens, targets):
             def loss_fn(p):
                 pc = (p if compute_dtype == jnp.float32
                       else _cast_floating(p, compute_dtype))
                 return tfm.lm_loss(cfg_, pc, tokens, targets, mesh_, axes_)
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
-            return _sgd_tree(params, grads, lr_), loss
+            updates, opt_state = transform.update(grads, opt_state, params)
+            return apply_updates(params, updates), opt_state, loss
 
-        self._step = jax.jit(step, donate_argnums=(0,))
+        self._step = jax.jit(step, donate_argnums=(0, 1))
 
     def fit_batch(self, tokens, targets) -> float:
         dsh = NamedSharding(self.mesh, P(self.axes.data, self.axes.seq))
         tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), dsh)
         targets = jax.device_put(jnp.asarray(targets, jnp.int32), dsh)
-        self.params, loss = self._step(self.params, tokens, targets)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, tokens, targets)
         return float(loss)
 
 
@@ -173,7 +190,8 @@ class PipelineParallelTrainer:
 
     def __init__(self, cfg: tfm.TransformerConfig, mesh: Mesh,
                  n_microbatches: int = 4, lr: float = 1e-2, seed: int = 0,
-                 data_axis: str = "data", stage_axis: str = "stage"):
+                 data_axis: str = "data", stage_axis: str = "stage",
+                 updater: str = "sgd"):
         if cfg.n_experts:
             raise ValueError("pipeline demo uses dense MLP blocks")
         if cfg.tie_embeddings:
@@ -207,6 +225,18 @@ class PipelineParallelTrainer:
             {"embed": full["embed"], "pos": full["pos"],
              "ln_f": full["ln_f"], "head": full["head"]},
             NamedSharding(mesh, P()))
+        from deeplearning4j_tpu.ops.updaters import (
+            UpdaterConfig,
+            make_updater,
+        )
+
+        self._transform = make_updater(UpdaterConfig(
+            updater=updater, learning_rate=lr, epsilon=1e-8))
+        # Optimizer state mirrors the params it moments (zeros_like
+        # preserves sharding: stage accumulators shard over `stage`,
+        # io accumulators replicate); the shared "step" scalar replicates.
+        self.stage_opt = self._transform.init(self.stage_params)
+        self.io_opt = self._transform.init(self.io_params)
         self._step = self._build_step()
 
     def _stage_fn(self, stage_params, x):
@@ -221,16 +251,25 @@ class PipelineParallelTrainer:
         return x
 
     def _build_step(self):
+        from deeplearning4j_tpu.ops.updaters import apply_updates
+
         lr, m = self.lr, self.m
         data_axis, stage_axis = self.axes
         stage_fn = self._stage_fn
+        transform = self._transform
         compute_dtype = jnp.dtype(self.cfg.dtype)
+        # shard_map prefix-specs for the optimizer states: accumulator
+        # subtrees follow their params' spec; the step counter replicates.
+        stage_opt_spec = {key: (P() if key == "step" else P(stage_axis))
+                          for key in self.stage_opt}
+        io_opt_spec = P()
 
         n_stages = self.n_stages
         k = -(-m // n_stages)          # ceil: per-stage microbatch share
         m_pad = k * n_stages
 
-        def step(stage_params, io_params, tokens, targets):
+        def step(stage_params, io_params, stage_opt, io_opt, tokens,
+                 targets):
             stage = lax.axis_index(stage_axis)
 
             def loss_fn(sp, iop):
@@ -280,15 +319,21 @@ class PipelineParallelTrainer:
                 lambda g: lax.pmean(lax.psum(g, stage_axis) * inv,
                                     data_axis), g_io)
             loss = lax.pmean(loss, data_axis)
-            return (_sgd_tree(stage_params, g_stage, lr),
-                    _sgd_tree(io_params, g_io, lr), loss)
+            up_stage, stage_opt = transform.update(g_stage, stage_opt,
+                                                   stage_params)
+            up_io, io_opt = transform.update(g_io, io_opt, io_params)
+            return (apply_updates(stage_params, up_stage),
+                    apply_updates(io_params, up_io),
+                    stage_opt, io_opt, loss)
 
         fn = shard_map(
             step, mesh=self.mesh,
-            in_specs=(P(stage_axis), P(), P(data_axis), P(data_axis)),
-            out_specs=(P(stage_axis), P(), P()),
+            in_specs=(P(stage_axis), P(), stage_opt_spec, io_opt_spec,
+                      P(data_axis), P(data_axis)),
+            out_specs=(P(stage_axis), P(), stage_opt_spec, io_opt_spec,
+                       P()),
             check_rep=False)
-        return jax.jit(fn, donate_argnums=(0, 1))
+        return jax.jit(fn, donate_argnums=(0, 1, 2, 3))
 
     def fit_batch(self, tokens, targets) -> float:
         tokens = jnp.asarray(tokens, jnp.int32)
@@ -302,6 +347,7 @@ class PipelineParallelTrainer:
         dsh = NamedSharding(self.mesh, P(self.axes[0]))
         tokens = jax.device_put(tokens, dsh)
         targets = jax.device_put(jnp.asarray(targets, jnp.int32), dsh)
-        self.stage_params, self.io_params, loss = self._step(
-            self.stage_params, self.io_params, tokens, targets)
+        (self.stage_params, self.io_params, self.stage_opt, self.io_opt,
+         loss) = self._step(self.stage_params, self.io_params,
+                            self.stage_opt, self.io_opt, tokens, targets)
         return float(loss)

@@ -344,6 +344,72 @@ def _gather(tree):
     return jax.tree_util.tree_map(np.asarray, tree)
 
 
+def _single_device_adam_steps(cfg, tokens, targets, lr, n_steps, seed):
+    from deeplearning4j_tpu.ops.updaters import (
+        UpdaterConfig, apply_updates, make_updater)
+
+    transform = make_updater(UpdaterConfig(
+        updater="adam", learning_rate=lr, epsilon=1e-8))
+    params = tfm.init_params(cfg, jax.random.PRNGKey(seed))
+    state = transform.init(params)
+    losses = []
+    for _ in range(n_steps):
+        loss, grads = jax.value_and_grad(
+            lambda p: tfm.lm_loss(cfg, p, tokens, targets))(params)
+        updates, state = transform.update(grads, state, params)
+        params = apply_updates(params, updates)
+        losses.append(float(loss))
+    return params, losses
+
+
+class TestTrainerUpdaters:
+    """updater='adam' on the mesh trainers must match single-device Adam
+    step for step (the optimizer state shards/replicates with its
+    params)."""
+
+    def test_hybrid_adam_matches_single_device(self):
+        cfg = tfm.TransformerConfig(vocab_size=41, d_model=16, n_heads=4,
+                                    n_layers=1, d_ff=32, max_len=16)
+        mesh = make_mesh((2, 2, 2), ("data", "seq", "model"),
+                         devices=_all_devices(8))
+        rng = np.random.default_rng(5)
+        tokens = rng.integers(0, cfg.vocab_size, (4, 8))
+        targets = rng.integers(0, cfg.vocab_size, (4, 8))
+        tr = HybridParallelTrainer(cfg, mesh, lr=0.01, seed=3,
+                                   updater="adam")
+        losses = [tr.fit_batch(tokens, targets) for _ in range(3)]
+        ref_p, ref_l = _single_device_adam_steps(
+            cfg, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(targets, jnp.int32), 0.01, 3, seed=3)
+        np.testing.assert_allclose(losses, ref_l, atol=1e-4)
+        for a, b in zip(jax.tree_util.tree_leaves(_gather(tr.params)),
+                        jax.tree_util.tree_leaves(_gather(ref_p))):
+            np.testing.assert_allclose(a, b, atol=5e-4)
+
+    def test_pipeline_adam_matches_single_device(self):
+        cfg = tfm.TransformerConfig(vocab_size=41, d_model=16, n_heads=4,
+                                    n_layers=4, d_ff=32, max_len=16)
+        mesh = make_mesh((2, 4), ("data", "stage"), devices=_all_devices(8))
+        rng = np.random.default_rng(6)
+        tokens = rng.integers(0, cfg.vocab_size, (8, 8))
+        targets = rng.integers(0, cfg.vocab_size, (8, 8))
+        tr = PipelineParallelTrainer(cfg, mesh, n_microbatches=2, lr=0.01,
+                                     seed=4, updater="adam")
+        losses = [tr.fit_batch(tokens, targets) for _ in range(3)]
+        ref_p, ref_l = _single_device_adam_steps(
+            cfg, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(targets, jnp.int32), 0.01, 3, seed=4)
+        np.testing.assert_allclose(losses, ref_l, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(tr.io_params["embed"]),
+            np.asarray(ref_p["embed"]), atol=5e-4)
+        got_w1 = np.asarray(tr.stage_params["mlp"]["w1"]).reshape(
+            cfg.n_layers, cfg.d_model, cfg.d_ff)
+        want_w1 = np.stack([np.asarray(l["mlp"]["w1"])
+                            for l in ref_p["layers"]])
+        np.testing.assert_allclose(got_w1, want_w1, atol=5e-4)
+
+
 def _single_device_steps(cfg, tokens, targets, lr, n_steps, seed):
     params = tfm.init_params(cfg, jax.random.PRNGKey(seed))
     losses = []

@@ -107,6 +107,29 @@ def _lr_at(cfg: UpdaterConfig, step: jax.Array) -> jax.Array:
     return jnp.asarray(cfg.learning_rate, jnp.float32)
 
 
+def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,
+                  final_frac: float = 0.1) -> Callable[[jax.Array], jax.Array]:
+    """Linear warmup to peak_lr over warmup_steps, then cosine decay to
+    final_frac * peak_lr at total_steps (held there after) — the standard
+    LM-pretraining schedule.  Returns a jit-safe fn(step) for
+    UpdaterConfig.lr_schedule / make_accum_train_step(lr_schedule=...)."""
+    if warmup_steps < 1 or total_steps <= warmup_steps:
+        raise ValueError(
+            f"need 1 <= warmup_steps ({warmup_steps}) < total_steps "
+            f"({total_steps})")
+
+    def schedule(step: jax.Array) -> jax.Array:
+        s = step.astype(jnp.float32)
+        warm = peak_lr * s / warmup_steps
+        frac = jnp.clip((s - warmup_steps) / (total_steps - warmup_steps),
+                        0.0, 1.0)
+        cos = final_frac + (1 - final_frac) * 0.5 * (
+            1 + jnp.cos(jnp.pi * frac))
+        return jnp.where(s < warmup_steps, warm, peak_lr * cos)
+
+    return schedule
+
+
 def make_updater(cfg: UpdaterConfig) -> UpdaterTransform:
     """Build the named updater transform. All returned callables are jit-safe.
 

@@ -72,7 +72,8 @@ def place_params(mesh: Mesh, tree, spec_tree):
 def make_accum_train_step(cfg: tfm.TransformerConfig, lr: float = 1e-3,
                           accum: int = 1, updater: str = "sgd",
                           clip_norm: float = None,
-                          weight_decay: float = 0.0):
+                          weight_decay: float = 0.0,
+                          lr_schedule=None):
     """Single-chip flagship train step: donated f32 master params, bf16
     compute when the config says so, gradient accumulation over `accum`
     sequential microbatches via lax.scan (activation memory of ONE
@@ -94,7 +95,8 @@ def make_accum_train_step(cfg: tfm.TransformerConfig, lr: float = 1e-3,
     compute_dtype = jnp.dtype(cfg.dtype)
     transform = make_updater(UpdaterConfig(
         updater=updater, learning_rate=lr, clip_norm=clip_norm,
-        weight_decay=weight_decay, epsilon=1e-8))
+        weight_decay=weight_decay, epsilon=1e-8,
+        lr_schedule=lr_schedule))
 
     def loss_fn(p32, tok, tgt):
         p = (_cast_floating(p32, compute_dtype)

@@ -59,8 +59,12 @@ def main() -> None:
                    for x in jax.tree_util.tree_leaves(params))
     print(f"params: {n_params/1e6:.1f}M  seq {seq}  batch {batch} "
           f"(accum {accum})  dtype {cfg.dtype}")
-    step, init_state = make_accum_train_step(cfg, lr=3e-4, accum=accum,
-                                             updater="adam")
+    from deeplearning4j_tpu.ops.updaters import warmup_cosine
+
+    step, init_state = make_accum_train_step(
+        cfg, lr=3e-4, accum=accum, updater="adam",
+        lr_schedule=warmup_cosine(3e-4, warmup_steps=max(2, steps // 10),
+                                  total_steps=steps))
     opt_state = init_state(params)
 
     rng = np.random.default_rng(0)

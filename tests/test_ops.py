@@ -154,6 +154,32 @@ class TestUpdaters:
             float(jnp.linalg.norm(g["w"])), 1.0, atol=1e-5
         )
 
+    def test_warmup_cosine_schedule(self):
+        from deeplearning4j_tpu.ops.updaters import warmup_cosine
+
+        sched = warmup_cosine(peak_lr=1e-3, warmup_steps=10,
+                              total_steps=100, final_frac=0.1)
+        # linear warmup: half way = half peak; peak at warmup end
+        np.testing.assert_allclose(float(sched(jnp.int32(5))), 5e-4,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(sched(jnp.int32(10))), 1e-3,
+                                   rtol=1e-6)
+        # cosine midpoint = mean of peak and floor; floor held after total
+        mid = float(sched(jnp.int32(55)))
+        np.testing.assert_allclose(mid, 1e-3 * (1 + 0.1) / 2, rtol=1e-5)
+        np.testing.assert_allclose(float(sched(jnp.int32(100))), 1e-4,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(sched(jnp.int32(500))), 1e-4,
+                                   rtol=1e-5)
+        with pytest.raises(ValueError, match="warmup"):
+            warmup_cosine(1e-3, warmup_steps=50, total_steps=50)
+        # drives an actual updater: first step uses the warmup lr
+        cfg = UpdaterConfig(updater=Updater.SGD, lr_schedule=sched)
+        tx = make_updater(cfg)
+        w = jnp.array([1.0])
+        updates, _ = tx.update(jnp.array([1.0]), tx.init(w), w)
+        np.testing.assert_allclose(float(updates[0]), -1e-4, rtol=1e-5)
+
     def test_updater_inside_jit(self):
         cfg = UpdaterConfig(updater=Updater.ADAM, learning_rate=0.01)
         tx = make_updater(cfg)

@@ -1,0 +1,18 @@
+# Convenience entry points. Everything here is reproducible by hand —
+# the targets just spell the one-liners out.
+
+.PHONY: test dryrun bench smoke
+
+test:
+	python -m pytest tests/ -x -q
+
+# Multichip dryrun (8 virtual CPU devices) + committed evidence log in
+# EVIDENCE/. Safe under a wedged TPU tunnel (env decision precedes jax).
+dryrun:
+	python -m deeplearning4j_tpu.dryrun 8
+
+bench:
+	python bench.py
+
+smoke:
+	BENCH_ONLY=lenet,transformer python bench.py

@@ -596,7 +596,16 @@ def _apply_baselines(results: list, canonical: bool,
             r["vs_baseline"] = None
             continue
         per_backend = pinned.setdefault(r["metric"], {})
-        if key not in per_backend and canonical:
+        # BENCH_FORCE_PIN lets a BENCH_ONLY smoke run pin a FIRST value
+        # for its backend (never overwrites): the TPU-window watcher runs
+        # a 2-row smoke first so a short green window banks its pins
+        # before attempting the long canonical suite.  Only shape-
+        # canonical runs qualify (default BATCH/STEPS) — an off-shape
+        # value must never become the permanent denominator.
+        shape_canonical = BATCH == 256 and STEPS == 100
+        may_pin = canonical or (shape_canonical
+                                and os.environ.get("BENCH_FORCE_PIN"))
+        if key not in per_backend and may_pin:
             per_backend[key] = r["value"]
             changed = True
         base = per_backend.get(key, r["value"] if not canonical else None)
@@ -772,10 +781,14 @@ def main() -> int:
     if backend_unreachable and os.environ.get(
             "BENCH_CPU_FALLBACK", "1") != "0":
         print("bench: TPU unreachable, falling back to CPU", file=sys.stderr)
+        fb_env = dict(_cpu_scrubbed_env(env), BENCH_NONCANONICAL="1")
+        # A degraded fallback run must never write pins, even when the
+        # parent (e.g. the TPU-window watcher) exported BENCH_FORCE_PIN.
+        fb_env.pop("BENCH_FORCE_PIN", None)
         try:
             proc = subprocess.run(
                 [sys.executable, str(REPO / "bench.py")],
-                env=dict(_cpu_scrubbed_env(env), BENCH_NONCANONICAL="1"),
+                env=fb_env,
                 capture_output=True, text=True,
                 timeout=ATTEMPT_TIMEOUT)
         except subprocess.TimeoutExpired as e:

@@ -324,12 +324,23 @@ def bench_scaling() -> dict:
         # No multi-chip hardware: still emit a NUMBER — the same 1-vs-8
         # measurement on an 8-virtual-CPU-device mesh in a child process.
         # That is a DP-plumbing check (shard_map + psum compile and scale
-        # mechanically), NOT an ICI efficiency; the row says so.
-        row = {"metric": "AlexNet-CIFAR10 DP scaling efficiency 1->8",
+        # mechanically), NOT an ICI efficiency; the METRIC NAME says so
+        # (VERDICT r4 weak #4) — the "scaling efficiency" name is reserved
+        # for real hardware so a skimmer cannot mistake host-core
+        # contention for an ICI curve.
+        row = {"metric": "AlexNet-CIFAR10 DP plumbing check 1->8 "
+                         "(virtual-cpu, not ICI)",
                "unit": "fraction", "value": None,
                "one_chip_examples_per_sec": round(one, 1),
                "note": f"only {n} real device(s); real-ICI efficiency "
                        f"needs hardware"}
+        if os.environ.get("BENCH_SCALING_NO_RECURSE"):
+            # We ARE the virtual-scaling child but the forced 8-device env
+            # did not take effect; recursing would fork children forever.
+            row["virtual_cpu_error"] = (
+                "inner child saw <2 devices — "
+                "xla_force_host_platform_device_count ignored")
+            return row
         try:
             virt = _virtual_scaling_curve()
         except Exception as e:  # noqa: BLE001 - plumbing row is best-effort
@@ -653,6 +664,13 @@ def run_suite() -> int:
         r["elapsed_s"] = round(time.perf_counter() - t0, 1)
         if backend is not None:
             r.setdefault("backend", backend)
+        if backend != "tpu":
+            # MFU against a CPU flops model is decorative (VERDICT r4
+            # weak #2): keep the `mfu` key TPU-only so the eventual real
+            # number is unmistakable.
+            for k in ("mfu", "mfu_target", "meets_target"):
+                if k in r:
+                    r[k + "_cpu"] = r.pop(k)
         results.append(r)
         _apply_baselines(results, canonical, backend)
         print(json.dumps(r), file=sys.stderr, flush=True)
@@ -670,6 +688,34 @@ def run_suite() -> int:
                               ("metric", "value", "unit", "vs_baseline")}
                              | ({"error": record["error"]}
                                 if "error" in record else {})), flush=True)
+    # A canonical run with an unexplained >10% same-backend drop must not
+    # silently become the results-of-record (VERDICT r4 weak #1): demand
+    # an annotation (BENCH_REGRESSION_NOTE) or leave the old record in
+    # place and park the new rows in a .flagged sidecar for analysis.
+    dropped = [r for r in results
+               if r.get("vs_baseline") is not None and r["vs_baseline"] < 0.9]
+    note = os.environ.get("BENCH_REGRESSION_NOTE")
+    if canonical and dropped and not note:
+        flagged = REPO / (out_name + ".flagged")
+        try:
+            (REPO / (out_name + ".partial")).replace(flagged)
+        except OSError:
+            pass
+        for r in dropped:
+            print(f"bench: REGRESSION {r['metric']}: vs_baseline="
+                  f"{r['vs_baseline']} — record NOT overwritten; "
+                  f"set BENCH_REGRESSION_NOTE='why' to accept, or re-pin",
+                  file=sys.stderr, flush=True)
+        print(f"bench: rows parked in {flagged.name}", file=sys.stderr)
+        return 1
+    if dropped and note:
+        for r in dropped:
+            r["regression_note"] = note
+        try:
+            (REPO / (out_name + ".partial")).write_text(
+                json.dumps(results, indent=1))
+        except OSError:
+            pass
     try:  # suite completed: promote the sidecar to the record file
         (REPO / (out_name + ".partial")).replace(REPO / out_name)
     except OSError as e:
@@ -705,7 +751,11 @@ def main() -> int:
     if os.environ.get("BENCH_SCALING_INNER"):
         # Child of _virtual_scaling_curve: 8 virtual CPU devices are
         # already forced in this env; print the one scaling row and exit.
+        # NO_RECURSE marks this process as the inner child so that, should
+        # the forced device count ever fail to take effect, bench_scaling
+        # degrades to an error row instead of spawning children forever.
         os.environ.pop("BENCH_SCALING_INNER")
+        os.environ["BENCH_SCALING_NO_RECURSE"] = "1"
         print(json.dumps(bench_scaling()), flush=True)
         return 0
     if os.environ.get("BENCH_CHILD"):

@@ -303,8 +303,10 @@ def cmd_lm(args) -> int:
 
     if args.eval is not None:
         # Held-out byte-level perplexity: mean NLL over non-overlapping
-        # cfg.max_len windows, exp() at the end (teacher forcing via the
-        # same lm_loss the trainer minimizes, inference routing).
+        # cfg.max_len windows, exp() at the end.  Scoring uses
+        # apply(train=False) — true inference routing (dense-masked MoE,
+        # no aux loss) — NOT the trainer's lm_loss, whose capacity-based
+        # routing and auxiliary term belong to training.
         ev_ids = np.frombuffer(pathlib.Path(args.eval).read_bytes(),
                                np.uint8).astype(np.int32)
         S_ev = cfg.max_len
@@ -315,13 +317,23 @@ def cmd_lm(args) -> int:
                         for i in range(n_win)])
         tgt = np.stack([ev_ids[i * S_ev + 1:(i + 1) * S_ev + 1]
                         for i in range(n_win)])
-        nll_fn = jax.jit(lambda p, t, g: tfm.lm_loss(cfg, p, t, g))
-        # batch windows to bound memory; mean of per-window means is the
-        # global mean (equal window sizes)
-        nlls = [float(nll_fn(params, jnp.asarray(tok[i:i + 8]),
-                             jnp.asarray(tgt[i:i + 8])))
-                for i in range(0, n_win, 8)]
-        nll = float(np.mean(nlls))
+
+        def batch_nll(p, t, g):
+            logp = jax.nn.log_softmax(
+                tfm.apply(cfg, p, t, train=False), axis=-1)
+            return -jnp.mean(
+                jnp.take_along_axis(logp, g[..., None], axis=-1)[..., 0])
+
+        nll_fn = jax.jit(batch_nll)
+        # Batch windows to bound memory.  Windows all have S_ev tokens, so
+        # the global mean is the WINDOW-count-weighted mean of per-batch
+        # means — a ragged final batch must not be over-weighted.
+        total = 0.0
+        for i in range(0, n_win, 8):
+            k = len(tok[i:i + 8])
+            total += k * float(nll_fn(params, jnp.asarray(tok[i:i + 8]),
+                                      jnp.asarray(tgt[i:i + 8])))
+        nll = total / n_win
         print(f"eval: {n_win} windows x {S_ev} bytes, "
               f"nll {nll:.4f}, perplexity {float(np.exp(nll)):.2f}")
 

@@ -138,6 +138,13 @@ def make_updater(cfg: UpdaterConfig) -> UpdaterTransform:
     save.
     """
     kind = Updater(cfg.updater)
+    if cfg.weight_decay and kind not in (Updater.ADAMW, Updater.LION):
+        # Decoupled decay is only defined for adamw/lion here; every other
+        # updater would silently ignore it (classic L2 lives in cfg.l2).
+        raise ValueError(
+            f"weight_decay={cfg.weight_decay} is ignored by updater "
+            f"'{cfg.updater}' — use updater='adamw' (or 'lion'), or the "
+            f"coupled cfg.l2 penalty instead")
 
     def init(params: PyTree) -> PyTree:
         state = {"step": jnp.zeros((), jnp.int32)}
@@ -244,6 +251,13 @@ def make_updater(cfg: UpdaterConfig) -> UpdaterTransform:
                 lambda m_, g: -lr * jnp.sign(b1 * m_ + (1 - b1) * g),
                 state["m"], grads,
             )
+            if cfg.weight_decay and params is not None:
+                # Decoupled decay, same convention as ADAMW (Lion is
+                # conventionally run with decoupled weight decay).
+                updates = jax.tree_util.tree_map(
+                    lambda u, p: u - lr * cfg.weight_decay * p,
+                    updates, params,
+                )
             new_state["m"] = jax.tree_util.tree_map(
                 lambda m_, g: b2 * m_ + (1 - b2) * g, state["m"], grads
             )

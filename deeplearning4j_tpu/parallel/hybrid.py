@@ -79,7 +79,9 @@ def make_accum_train_step(cfg: tfm.TransformerConfig, lr: float = 1e-3,
     sequential microbatches via lax.scan (activation memory of ONE
     microbatch; pair with cfg.remat for long sequences).  Any updater
     from ops.updaters ('adam' is the realistic pretraining choice; the
-    optimizer state lives in f32 beside the master params).
+    optimizer state lives in f32 beside the master params).  Decoupled
+    `weight_decay` requires updater='adamw' or 'lion' — make_updater
+    raises for updaters that would silently ignore it.
 
     Returns (step, init_state):
       init_state(params) -> opt_state

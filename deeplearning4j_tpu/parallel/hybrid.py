@@ -197,12 +197,11 @@ class PipelineParallelTrainer:
                  data_axis: str = "data", stage_axis: str = "stage",
                  updater: str = "sgd"):
         if cfg.n_experts:
-            raise ValueError("pipeline demo uses dense MLP blocks")
-        if cfg.tie_embeddings:
-            raise ValueError(
-                "pipeline trainer keeps a separate head param (embed and "
-                "head grads accumulate on different stages); use "
-                "tie_embeddings=False here")
+            # Documented boundary (PARITY): MoE rides the dp/sp/tp/ep
+            # mesh (HybridParallelTrainer); pipeline stages here are
+            # dense-MLP only.
+            raise ValueError("pipeline trainer uses dense MLP blocks; "
+                             "train MoE configs on the dp/sp/tp/ep mesh")
         self.cfg = cfg
         self.mesh = mesh
         self.lr = lr
@@ -225,10 +224,15 @@ class PipelineParallelTrainer:
         self.stage_params = jax.tree_util.tree_map(
             lambda a: jax.device_put(
                 a, NamedSharding(mesh, P(stage_axis))), stacked)
-        self.io_params = jax.device_put(
-            {"embed": full["embed"], "pos": full["pos"],
-             "ln_f": full["ln_f"], "head": full["head"]},
-            NamedSharding(mesh, P()))
+        # Tied configs carry no separate head: lm_head(io) scores with
+        # embed.T, and the stage-psum on io grads below accumulates the
+        # tied leaf's two contributions (embedding lookup + projection)
+        # across every stage's disjoint microbatch share.
+        io = {"embed": full["embed"], "pos": full["pos"],
+              "ln_f": full["ln_f"]}
+        if not cfg.tie_embeddings:
+            io["head"] = full["head"]
+        self.io_params = jax.device_put(io, NamedSharding(mesh, P()))
         from deeplearning4j_tpu.ops.updaters import (
             UpdaterConfig,
             make_updater,
@@ -297,7 +301,7 @@ class PipelineParallelTrainer:
                 x = iop["embed"][my_tok] + iop["pos"][None, None, :s, :]
                 y = gpipe_apply(stage_fn, sp, x, stage_axis, m)
                 y = tfm._layer_norm(iop["ln_f"], y)
-                logits = jnp.einsum("kbsd,dv->kbsv", y, iop["head"])
+                logits = jnp.einsum("kbsd,dv->kbsv", y, tfm.lm_head(iop))
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 nll = -jnp.take_along_axis(
                     logp, my_tgt[..., None], axis=-1)[..., 0]  # [K,mb_b,s]

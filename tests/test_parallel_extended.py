@@ -683,10 +683,16 @@ class TestGPipeMemoryHygiene:
 
 
 class TestPipelineParallelTrainer:
-    def test_matches_single_device(self):
+    @pytest.mark.parametrize("tied", [False, True])
+    def test_matches_single_device(self, tied):
+        """Untied AND tied (GPT-2-style) configs: under tying the embed
+        leaf receives two gradient contributions (lookup + lm-head
+        projection), each computed on a stage's disjoint microbatch
+        share, so this also proves the stage-psum accumulates the tied
+        leaf correctly (the flagship gpt2_small config ties)."""
         cfg = tfm.TransformerConfig(
             vocab_size=41, d_model=16, n_heads=4, n_layers=4, d_ff=32,
-            max_len=16)
+            max_len=16, tie_embeddings=tied)
         mesh = make_mesh((2, 4), ("data", "stage"),
                          devices=_all_devices(8))
         rng = np.random.default_rng(4)
@@ -707,9 +713,12 @@ class TestPipelineParallelTrainer:
         np.testing.assert_allclose(
             np.asarray(trainer.io_params["embed"]),
             np.asarray(ref_params["embed"]), atol=5e-4)
-        np.testing.assert_allclose(
-            np.asarray(trainer.io_params["head"]),
-            np.asarray(ref_params["head"]), atol=5e-4)
+        if tied:
+            assert "head" not in trainer.io_params
+        else:
+            np.testing.assert_allclose(
+                np.asarray(trainer.io_params["head"]),
+                np.asarray(ref_params["head"]), atol=5e-4)
         # and the stage-sharded blocks round-trip to the layer stack
         got_w1 = np.asarray(trainer.stage_params["mlp"]["w1"]).reshape(
             cfg.n_layers, cfg.d_model, cfg.d_ff)

@@ -173,6 +173,67 @@ def fetch_cifar10(dest: Optional[Path] = None) -> Path:
 
 
 # ---------------------------------------------------------------------------
+# text8 (the reference's Word2Vec tests train on real bundled corpora —
+# `Word2VecTests.java`; text8 is the standard public stand-in at scale)
+# ---------------------------------------------------------------------------
+
+TEXT8_URLS = (
+    "https://mattmahoney.net/dc/text8.zip",
+    "https://data.deepai.org/text8.zip",
+)
+
+
+def fetch_text8(dest: Optional[Path] = None) -> Path:
+    """Download-and-cache text8 (~31 MB zip, 100 MB of lowercase
+    space-separated English).  Returns the path of the extracted file.
+    `TEXT8_PATH` points at a pre-downloaded copy (air-gapped hosts);
+    raises when offline.  No published SHA-256 exists for the canonical
+    host, so the body is validated structurally instead (exact 1e8-byte
+    length, a-z/space alphabet)."""
+    import zipfile
+
+    override = os.environ.get("TEXT8_PATH")
+    if override:
+        p = Path(override)
+        if not p.is_file():
+            raise FileNotFoundError(f"TEXT8_PATH={override} does not exist")
+        return p
+    root = Path(dest) if dest else cache_dir("text8")
+    extracted = root / "text8"
+    if extracted.is_file():
+        return extracted
+    if not downloads_allowed():
+        raise RuntimeError("text8 download forbidden (DL4J_NO_DOWNLOAD)")
+    archive = root / "text8.zip"
+    last_err: Exception = RuntimeError("no text8 URL configured")
+    for url in TEXT8_URLS:
+        try:
+            download(url, archive)
+            break
+        except Exception as e:  # noqa: BLE001 - try the mirror
+            last_err = e
+            archive.unlink(missing_ok=True)
+    else:
+        raise RuntimeError(f"text8 unreachable: {last_err}")
+    try:
+        with zipfile.ZipFile(archive) as zf:
+            with zf.open("text8") as f:
+                head = f.read(4096)
+            if not head or not set(head) <= set(b"abcdefghijklmnopqrstuvwxyz "):
+                raise ValueError("text8 body failed structural check")
+            zf.extract("text8", root)
+    except Exception:
+        archive.unlink(missing_ok=True)
+        extracted.unlink(missing_ok=True)
+        raise
+    if extracted.stat().st_size != 100_000_000:
+        size = extracted.stat().st_size
+        extracted.unlink()
+        raise ValueError(f"text8 wrong size: {size}")
+    return extracted
+
+
+# ---------------------------------------------------------------------------
 # LFW (reference LFWDataSetIterator / LFWLoader)
 # ---------------------------------------------------------------------------
 

@@ -20,19 +20,21 @@ from deeplearning4j_tpu.models import MultiLayerNetwork, lenet_mnist
 from deeplearning4j_tpu.parallel import DataParallelTrainer
 
 
-def main():
+def main(steps: int = 5, batch_per_device: int = 32):
     n = len(jax.devices())
     print(f"{n} device(s): {jax.devices()[0].platform}")
     net = MultiLayerNetwork(lenet_mnist(updater="sgd")).init()
     trainer = DataParallelTrainer(net)
     rng = np.random.default_rng(0)
-    b = 32 * n
+    b = batch_per_device * n
     x = rng.random((b, 28, 28, 1), dtype=np.float32)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, b)]
-    for step in range(5):
+    loss = None
+    for step in range(steps):
         loss = trainer.fit_batch(x, y)
         print(f"step {step}: loss {float(loss):.4f} "
               f"(batch {b} sharded over {n} devices, grads pmean'd)")
+    return loss
 
 
 if __name__ == "__main__":

@@ -12,12 +12,14 @@ from deeplearning4j_tpu.datasets.fetchers import iris_dataset
 from deeplearning4j_tpu.models import MultiLayerNetwork, iris_mlp
 
 
-def main():
+def main(epochs: int = 200):
     ds = iris_dataset()
     train, test = ds.split_test_and_train(120, seed=0)
     net = MultiLayerNetwork(iris_mlp()).init()
-    net.fit((train.features, train.labels), epochs=200)
-    print(net.evaluate(test.features, test.labels).stats())
+    net.fit((train.features, train.labels), epochs=epochs)
+    ev = net.evaluate(test.features, test.labels)
+    print(ev.stats())
+    return ev
 
 
 if __name__ == "__main__":

@@ -28,13 +28,15 @@ from deeplearning4j_tpu.parallel import transformer as tfm
 from deeplearning4j_tpu.parallel.hybrid import HybridParallelTrainer
 
 
-def main():
+def main(steps: int = 3, seq_per_device: int = 512, d_model: int = 128,
+         n_heads: int = 8, d_ff: int = 256):
     n = len(jax.devices())
     seq_dev = max(d for d in (1, 2, 4, 8) if n % d == 0 and d <= n)
     mesh = make_mesh((n // seq_dev, seq_dev), ("data", "seq"))
-    S = 512 * seq_dev          # sequence longer than one device's share
-    cfg = tfm.TransformerConfig(vocab_size=1024, d_model=128, n_heads=8,
-                                n_layers=2, d_ff=256, max_len=S)
+    S = seq_per_device * seq_dev   # sequence longer than one device's share
+    cfg = tfm.TransformerConfig(vocab_size=1024, d_model=d_model,
+                                n_heads=n_heads, n_layers=2, d_ff=d_ff,
+                                max_len=S)
     # no model axis in this mesh: params replicated, sequence sharded
     axes = tfm.MeshAxes(data="data", seq="seq", model=None)
     trainer = HybridParallelTrainer(cfg, mesh, lr=1e-2, axes=axes)
@@ -44,9 +46,11 @@ def main():
     targets = np.roll(tokens, -1, axis=1)
     print(f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}, "
           f"sequence length {S} sharded {seq_dev}-way")
-    for step in range(3):
+    loss = None
+    for step in range(steps):
         loss = trainer.fit_batch(tokens, targets)
         print(f"step {step}: loss {float(loss):.4f}")
+    return loss
 
 
 if __name__ == "__main__":

@@ -26,8 +26,19 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+# Softmax row-stats (lse, delta) cross the pallas_call boundary in
+# LANE-REPLICATED form [B*H, S, REP]: Mosaic tiles VMEM blocks (8, 128)
+# over the last two dims, so a compact [B*H, S] array can never be
+# blocked per-(batch*head) row — the size-1 sublane dim is illegal.
+# Replicating each scalar across the 128 lanes keeps every stat block
+# (bq, 128)-shaped and sublane-aligned with the [bq, bk] score tiles it
+# corrects, so the kernels never transpose.  (Same layout the TPU
+# flash-attention literature uses for its l/m residuals.)
+REP = 128
 
 
 def flash_enabled() -> bool:
@@ -68,8 +79,11 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq,
     """Grid program: one (batch*head, q_block) pair.
 
     q_ref [bq, d]; k_ref/v_ref [s, d] (whole sequence for this bh);
-    o_ref [bq, d]; lse_ref [bq] (logsumexp of the scaled scores, consumed
-    by the fused backward).
+    o_ref [bq, d]; lse_ref [bq, REP] (lane-replicated logsumexp of the
+    scaled scores, consumed by the fused backward).
+
+    All row stats are kept 2-D [bq, 1] (keepdims reductions) so every
+    intermediate is a sublane vector Mosaic can tile.
     """
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale       # [bq, d]
@@ -78,7 +92,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq,
     q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
 
     def body(j, carry):
-        m, l, acc = carry
+        m, l, acc = carry                                 # [bq,1]x2,[bq,d]
         k_blk = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(
@@ -88,27 +102,28 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq,
             k_pos = j * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        blk_m = jnp.max(s, axis=1)                        # [bq]
+        blk_m = jnp.max(s, axis=1, keepdims=True)         # [bq, 1]
         new_m = jnp.maximum(m, blk_m)
-        p = jnp.exp(s - new_m[:, None])
+        p = jnp.exp(s - new_m)
         if causal:
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         scale_old = jnp.exp(m - new_m)
-        l = l * scale_old + jnp.sum(p, axis=1)
-        acc = acc * scale_old[:, None] + jax.lax.dot_general(
+        l = l * scale_old + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * scale_old + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bq, d]
         return new_m, l, acc
 
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
     # Causal: kv blocks past this q block are fully masked — skip them.
     n_blocks = jnp.minimum(
         n_kv_blocks, (qi * bq + bq + bk - 1) // bk) if causal else n_kv_blocks
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[...] = jnp.broadcast_to(
+        m + jnp.log(jnp.maximum(l, 1e-30)), (bq, REP))
 
 
 def _fold(x, b, s, h, d):
@@ -121,7 +136,12 @@ def _unfold(x, b, s, h, d):
 
 
 def _flash_forward(q, k, v, causal: bool, interpret: bool):
-    """Returns (out [B,S,H,D], lse [B*H, S])."""
+    """Returns (out [B,S,H,D], lse [B*H, S]).
+
+    The kernel emits lse lane-replicated [B*H, S, REP] (see REP above);
+    the compact [B*H, S] view handed to callers (ring attention, the
+    fused backward's residuals) is lane 0.
+    """
     b, s, h, d = q.shape
     bq = _pick_block(s, kind="q")
     bk = _pick_block(s, kind="k")
@@ -133,7 +153,7 @@ def _flash_forward(q, k, v, causal: bool, interpret: bool):
     kernel = functools.partial(
         _attn_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
         n_kv_blocks=n_kv_blocks)
-    out, lse = pl.pallas_call(
+    out, lse_rep = pl.pallas_call(
         kernel,
         grid=(b * h, s // bq),
         in_specs=[
@@ -143,98 +163,116 @@ def _flash_forward(q, k, v, causal: bool, interpret: bool):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((None, bq, REP), lambda bh, qi: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s, REP), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return _unfold(out, b, s, h, d), lse
+    return _unfold(out, b, s, h, d), lse_rep[..., 0]
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, bq, bk, n_q_blocks):
-    """Grid program: one (batch*head, kv_block) pair; K/V block resident,
-    Q/dO/lse/delta stream through in bq-sized blocks."""
-    j = pl.program_id(1)
-    k_blk = k_ref[0].astype(jnp.float32)              # [bk, d]
-    v_blk = v_ref[0].astype(jnp.float32)
-    d = k_blk.shape[-1]
-    k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                dk_ref, dv_ref, dk_acc, dv_acc, *,
+                scale, causal, bq, bk, n_q_blocks):
+    """Grid program: (batch*head, kv_block, q_block), q innermost.
 
-    def body(i, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(i * bq, bq)]
-        delta_blk = delta_ref[0, pl.ds(i * bq, bq)]
+    The K/V block is revisited across the inner q steps while Q/dO and
+    the row stats stream through as (bq, ·) blocks — every block is
+    DMA-sized by the grid, so VMEM use is independent of S.  dK/dV
+    accumulate in f32 VMEM scratch (persistent across the sequential
+    inner steps) and flush once on the last q step.
+    """
+    j, i = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # Causal: this (q, kv) block pair touches the triangle iff the last
+    # q position reaches the first k position.
+    live = (i * bq + bq - 1 >= j * bk) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        k_blk = k_ref[0].astype(jnp.float32)              # [bk, d]
+        v_blk = v_ref[0].astype(jnp.float32)
+        q_blk = q_ref[0].astype(jnp.float32)              # [bq, d]
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[:, :1]                          # [bq, 1]
+        delta_blk = delta_ref[:, :1]
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
         if causal:
             q_pos = i * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse_blk[:, None])                 # [bq, bk]
-        dv = dv + jax.lax.dot_general(
+        p = jnp.exp(s - lse_blk)                          # [bq, bk]
+        dv_acc[...] += jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bk, d]
         dp = jax.lax.dot_general(
             do_blk, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bq, bk]
-        ds = p * (dp - delta_blk[:, None])
-        dk = dk + jax.lax.dot_general(
+        ds = p * (dp - delta_blk)
+        dk_acc[...] += jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bk, d]
-        return dk, dv
 
-    # Causal: q blocks strictly before this kv block are fully masked.
-    start = (j * bk) // bq if causal else 0
-    zeros = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, n_q_blocks, body, (zeros, zeros))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(i == n_q_blocks - 1)
+    def _flush():
+        dk_ref[0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, bq, bk, n_kv_blocks):
-    """Grid program: one (batch*head, q_block) pair; Q block resident,
-    K/V stream through."""
-    qi = pl.program_id(1)
-    q_blk = q_ref[0].astype(jnp.float32)              # [bq, d]
-    do_blk = do_ref[0].astype(jnp.float32)
-    lse_blk = lse_ref[0]
-    delta_blk = delta_ref[0]
-    d = q_blk.shape[-1]
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale, causal, bq, bk, n_kv_blocks):
+    """Grid program: (batch*head, q_block, kv_block), kv innermost; the
+    Q block is revisited while K/V stream through.  Same scratch-
+    accumulate-flush scheme as _dkv_kernel."""
+    qi, jb = pl.program_id(1), pl.program_id(2)
 
-    def body(jb, dq):
-        k_blk = k_ref[0, pl.ds(jb * bk, bk), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(jb * bk, bk), :].astype(jnp.float32)
+    @pl.when(jb == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    live = (jb * bk <= qi * bq + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q_blk = q_ref[0].astype(jnp.float32)              # [bq, d]
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[:, :1]                          # [bq, 1]
+        delta_blk = delta_ref[:, :1]
+        k_blk = k_ref[0].astype(jnp.float32)              # [bk, d]
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
         if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
             k_pos = jb * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse_blk[:, None])
+        p = jnp.exp(s - lse_blk)
         dp = jax.lax.dot_general(
             do_blk, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bq, bk]
-        ds = p * (dp - delta_blk[:, None])
-        return dq + jax.lax.dot_general(
+        ds = p * (dp - delta_blk)
+        dq_acc[...] += jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bq, d]
 
-    # Causal: kv blocks past this q block are fully masked.
-    n_blocks = jnp.minimum(
-        n_kv_blocks, (qi * bq + bq + bk - 1) // bk) if causal else n_kv_blocks
-    dq = jax.lax.fori_loop(0, n_blocks, body,
-                           jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(jb == n_kv_blocks - 1)
+    def _flush():
+        dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
 def _flash_backward(q, k, v, o, lse, g, causal: bool, interpret: bool):
@@ -260,45 +298,50 @@ def _bwd_block(q, k, v, g, lse, delta, causal: bool, interpret: bool):
     scale = 1.0 / (d ** 0.5)
 
     qf, kf, vf, gf = (_fold(x, b, s, h, d) for x in (q, k, v, g))
+    # Lane-replicate the compact row stats for the kernels (see REP).
+    lse_rep = jnp.broadcast_to(lse[:, :, None], (b * h, s, REP))
+    delta_rep = jnp.broadcast_to(delta[:, :, None], (b * h, s, REP))
 
-    dkv = pl.pallas_call(
+    dkf, dvf = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq,
                           bk=bk, n_q_blocks=s // bq),
-        grid=(b * h, s // bk),
+        grid=(b * h, s // bk, s // bq),
         in_specs=[
-            pl.BlockSpec((1, s, d), lambda bh, j: (bh, 0, 0)),   # q
-            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),  # k
-            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),  # v
-            pl.BlockSpec((1, s, d), lambda bh, j: (bh, 0, 0)),   # do
-            pl.BlockSpec((1, s), lambda bh, j: (bh, 0)),         # lse
-            pl.BlockSpec((1, s), lambda bh, j: (bh, 0)),         # delta
+            pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),    # q
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),    # k
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),    # v
+            pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),    # do
+            pl.BlockSpec((None, bq, REP), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((None, bq, REP), lambda bh, j, i: (bh, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
         ],
         out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
-    dkf, dvf = dkv
+    )(qf, kf, vf, gf, lse_rep, delta_rep)
 
     dqf = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq,
                           bk=bk, n_kv_blocks=s // bk),
-        grid=(b * h, s // bq),
+        grid=(b * h, s // bq, s // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),  # q
-            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),    # k
-            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),    # v
-            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),  # do
-            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),        # lse
-            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),        # delta
+            pl.BlockSpec((1, bq, d), lambda bh, qi, jb: (bh, qi, 0)),  # q
+            pl.BlockSpec((1, bk, d), lambda bh, qi, jb: (bh, jb, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda bh, qi, jb: (bh, jb, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda bh, qi, jb: (bh, qi, 0)),  # do
+            pl.BlockSpec((None, bq, REP), lambda bh, qi, jb: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, REP), lambda bh, qi, jb: (bh, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, jb: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
+    )(qf, kf, vf, gf, lse_rep, delta_rep)
 
     return tuple(_unfold(x, b, s, h, d) for x in (dqf, dkf, dvf))
 

@@ -1,0 +1,77 @@
+#!/usr/bin/env bash
+# TPU-window watcher (VERDICT r4 next-round #1/#2).
+#
+# The axon tunnel to the one real chip wedges for hours and answers in
+# short windows (round 3: 18 minutes).  This script probes cheaply and,
+# the moment a real matmul round-trips, runs the harvest sequence in
+# strict value order — flagship rows first, long tail after — so even a
+# short window banks committed TPU evidence.  Everything is logged to
+# EVIDENCE/ and committed with `git commit --only` (never touches the
+# builder's staged work).
+#
+# Usage: nohup tools/tpu_watch.sh >/tmp/tpu_watch.out 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+REPO=$PWD
+LOG_DIR=$REPO/EVIDENCE
+mkdir -p "$LOG_DIR"
+PROBE_S=${TPU_WATCH_PROBE_TIMEOUT:-180}
+SLEEP_S=${TPU_WATCH_INTERVAL:-300}
+LOCK=/tmp/dl4j_git.lock
+STAMP() { date -u +%Y%m%d_%H%M; }
+
+probe() {
+    # Fresh process per probe: jax caches a failed backend for process
+    # lifetime, and a wedged tunnel HANGS (not errors) in init.
+    timeout "$PROBE_S" python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+y = (x @ x).block_until_ready()
+assert jax.default_backend() == 'tpu', jax.default_backend()
+print('probe ok', jax.devices())
+" >/dev/null 2>&1
+}
+
+commit_paths() {
+    local msg=$1; shift
+    flock -w 120 "$LOCK" git commit --only -m "$msg" -- "$@" \
+        >/dev/null 2>&1 || true
+}
+
+stage() {
+    # stage <name> <timeout_s> <env...> -- runs bench.py, logs, commits.
+    local name=$1 tmo=$2; shift 2
+    local log="$LOG_DIR/tpu_${name}_$(STAMP).log"
+    {
+        echo "== $name  $(date -u)  sha=$(git rev-parse --short HEAD)"
+        env | grep -E 'BENCH_|XLA_|JAX_' || true
+    } >"$log"
+    timeout "$tmo" env "$@" python bench.py >>"$log" 2>&1
+    local rc=$?
+    echo "== rc=$rc  $(date -u)" >>"$log"
+    git add -f "$log" >/dev/null 2>&1
+    commit_paths "TPU harvest: $name (rc=$rc, watcher)" \
+        "$log" BENCH_full.json BENCH_smoke.json .bench_baseline.json
+    return $rc
+}
+
+echo "watcher armed $(date -u); probing every ${SLEEP_S}s"
+while :; do
+    if probe; then
+        echo "GREEN $(date -u) — harvesting"
+        # Value order: flagship transformer (proves the flash kernel fix
+        # + MFU row), GPT-2 124M, flash A/B, S=16k long-context, fused
+        # LSTM A/B, then the full canonical suite (warm cache makes the
+        # already-run rows cheap).
+        stage transformer 1800 BENCH_ONLY=transformer BENCH_FORCE_PIN=1
+        stage gpt2        2400 BENCH_ONLY=gpt2 BENCH_FORCE_PIN=1
+        stage flashab     1800 BENCH_ONLY=flashab BENCH_FORCE_PIN=1
+        stage longctx     1800 BENCH_ONLY=longctx BENCH_FORCE_PIN=1
+        stage lstm        1800 BENCH_ONLY=lstm BENCH_FORCE_PIN=1
+        stage gpt2mem     2400 BENCH_ONLY=gpt2mem
+        stage canonical   5400 BENCH_ATTEMPT_TIMEOUT=5400
+        echo "harvest complete $(date -u); watcher continues"
+        touch /tmp/tpu_harvest_done
+    fi
+    sleep "$SLEEP_S"
+done

@@ -169,6 +169,8 @@ def _flash_forward(q, k, v, causal: bool, interpret: bool):
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, s, REP), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(qf, kf, vf)
     return _unfold(out, b, s, h, d), lse_rep[..., 0]
@@ -322,6 +324,10 @@ def _bwd_block(q, k, v, g, lse, delta, causal: bool, interpret: bool):
                    jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
+        # Inner q dim is sequential (scratch accumulation); outer two are
+        # independent, letting Mosaic pipeline/parallelize them.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, gf, lse_rep, delta_rep)
 
@@ -340,6 +346,8 @@ def _bwd_block(q, k, v, g, lse, delta, causal: bool, interpret: bool):
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, jb: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, gf, lse_rep, delta_rep)
 

@@ -66,6 +66,7 @@ while :; do
         stage transformer 1800 BENCH_ONLY=transformer BENCH_FORCE_PIN=1
         stage gpt2        2400 BENCH_ONLY=gpt2 BENCH_FORCE_PIN=1
         stage flashab     1800 BENCH_ONLY=flashab BENCH_FORCE_PIN=1
+        stage decode      1800 BENCH_ONLY=decode BENCH_FORCE_PIN=1
         stage longctx     1800 BENCH_ONLY=longctx BENCH_FORCE_PIN=1
         stage lstm        1800 BENCH_ONLY=lstm BENCH_FORCE_PIN=1
         stage gpt2mem     2400 BENCH_ONLY=gpt2mem

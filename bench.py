@@ -702,16 +702,18 @@ def bench_gpt2_mem() -> dict:
         rng.integers(0, cfg.vocab_size, (b_global, 1024)).astype(np.int32),
         rng.integers(0, cfg.vocab_size, (b_global, 1024)).astype(np.int32))
     state = {"p": params, "o": init_state(params)}
+    # block_until_ready INSIDE each timed region: dispatch is async, so
+    # an unblocked perf_counter window times the enqueue, not the step.
     t0 = time.perf_counter()
     state["p"], state["o"], loss = step(state["p"], state["o"],
                                         tokens, targets)
-    first_s = time.perf_counter() - t0  # includes compile
     losses = [float(jax.block_until_ready(loss))]
+    first_s = time.perf_counter() - t0  # includes compile
     t0 = time.perf_counter()
     state["p"], state["o"], loss = step(state["p"], state["o"],
                                         tokens, targets)
-    steady_s = time.perf_counter() - t0
     losses.append(float(jax.block_until_ready(loss)))
+    steady_s = time.perf_counter() - t0
     assert all(np.isfinite(v) for v in losses), losses
     # ru_maxrss is KiB on Linux: host-process peak, which on CPU includes
     # the XLA buffers themselves — the number that answers "does the 124M

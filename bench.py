@@ -917,6 +917,27 @@ def _cpu_scrubbed_env(env: dict) -> dict:
     return scrub_tpu_env(env)
 
 
+def _banked_tpu_pins():
+    """Real-TPU first-pin values banked by a previous green tunnel window
+    (committed in .bench_baseline.json under the 'tpu' backend key).
+    Surfaced on the CPU-fallback record so a wedged-tunnel round still
+    carries the framework's real-TPU evidence in its one JSON line."""
+    try:
+        data = json.loads((REPO / ".bench_baseline.json").read_text())
+        pins = {}
+        for m, e in data.get("pinned", {}).items():
+            if not isinstance(e, dict):
+                continue
+            if "value" in e:  # transitional single-slot {value, backend}
+                if e.get("backend") == "tpu":
+                    pins[m] = e["value"]
+            elif "tpu" in e:  # backend-keyed format
+                pins[m] = e["tpu"]
+        return pins or None
+    except (OSError, ValueError):
+        return None
+
+
 def _first_json_line(text: str):
     for ln in (text or "").splitlines():
         ln = ln.strip()
@@ -1045,12 +1066,19 @@ def main() -> int:
                 # a CPU number ratioed against a TPU-pinned baseline would
                 # read as a perf regression; don't compare across backends
                 record["vs_baseline"] = None
+                banked = _banked_tpu_pins()
+                if banked:
+                    record["tpu_rows_banked"] = banked
                 print(json.dumps(record))
                 return 0
-    print(json.dumps({"metric": RECORD_METRIC, "value": None,
-                      "unit": "examples/sec", "vs_baseline": None,
-                      "error": f"all {RETRIES} attempts failed; last: "
-                               f"{last_tail[:500]}"}))
+    out = {"metric": RECORD_METRIC, "value": None,
+           "unit": "examples/sec", "vs_baseline": None,
+           "error": f"all {RETRIES} attempts failed; last: "
+                    f"{last_tail[:500]}"}
+    banked = _banked_tpu_pins()
+    if banked:
+        out["tpu_rows_banked"] = banked
+    print(json.dumps(out))
     return 1
 
 

@@ -33,9 +33,30 @@ print('probe ok', jax.devices())
 }
 
 commit_paths() {
+    # `git commit --only` errors on untracked paths, which is how the
+    # round-5 01:01 UTC window's TPU pin sidecar was lost (it was also
+    # gitignored then — both fixed): force-add first.  One flock-held
+    # critical section so the add+commit is atomic vs the builder's own
+    # git use; `--only` keeps the builder's staged work out of the
+    # commit, and a failed commit resets the force-added paths so the
+    # shared index is left as found.
     local msg=$1; shift
-    flock -w 120 "$LOCK" git commit --only -m "$msg" -- "$@" \
-        >/dev/null 2>&1 || true
+    local p; local -a have=()
+    for p in "$@"; do [ -e "$p" ] && have+=("$p"); done
+    [ ${#have[@]} -gt 0 ] || return 0
+    flock -w 120 "$LOCK" bash -c '
+        msg=$1; shift
+        ok=()
+        for p in "$@"; do
+            if git add -f -- "$p" >/dev/null 2>&1 ||
+               git ls-files --error-unmatch -- "$p" >/dev/null 2>&1; then
+                ok+=("$p")
+            fi
+        done
+        [ ${#ok[@]} -gt 0 ] || exit 0
+        git commit --only -m "$msg" -- "${ok[@]}" >/dev/null 2>&1 ||
+            git reset -q -- "${ok[@]}" 2>/dev/null || true
+    ' _ "$msg" "${have[@]}" || true
 }
 
 stage() {
@@ -49,7 +70,6 @@ stage() {
     timeout "$tmo" env "$@" python bench.py >>"$log" 2>&1
     local rc=$?
     echo "== rc=$rc  $(date -u)" >>"$log"
-    git add -f "$log" >/dev/null 2>&1
     commit_paths "TPU harvest: $name (rc=$rc, watcher)" \
         "$log" BENCH_full.json BENCH_smoke.json .bench_baseline.json
     return $rc

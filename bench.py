@@ -731,14 +731,45 @@ def bench_gpt2_mem() -> dict:
                     "this row answers off-TPU"}
 
 
+def _flash_fallback(row_fn):
+    """Run a transformer row; if it dies on TPU with the Pallas flash
+    path enabled (e.g. a Mosaic lowering rejection the CPU interpreter
+    cannot foresee), retry once with XLA attention so a short green
+    tunnel window still banks a flagship number.  The kernel-specific
+    rows (flashab, longctx) are deliberately NOT wrapped: their metric
+    IS the kernel, so an honest error row is the right outcome there."""
+    import jax
+
+    try:
+        row = row_fn()
+    except Exception as e:  # noqa: BLE001 - fall back, then re-raise if that fails too
+        if (jax.default_backend() != "tpu"
+                or os.environ.get("DL4J_TPU_FLASH") == "0"):
+            raise
+        os.environ["DL4J_TPU_FLASH"] = "0"
+        jax.clear_caches()
+        try:
+            row = row_fn()
+            row["attention"] = "xla (flash kernel failed)"
+            row["flash_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+            return row
+        finally:
+            os.environ.pop("DL4J_TPU_FLASH", None)
+    from deeplearning4j_tpu.parallel import kernels
+
+    row.setdefault("attention",
+                   "pallas-flash" if kernels.flash_enabled() else "xla")
+    return row
+
+
 BENCHES = {
     "lenet": bench_lenet,
     "iris": bench_iris,
     "lstm": bench_lstm,
     "word2vec": bench_word2vec,
     "scaling": bench_scaling,
-    "transformer": bench_transformer,
-    "gpt2": bench_gpt2,
+    "transformer": lambda: _flash_fallback(bench_transformer),
+    "gpt2": lambda: _flash_fallback(bench_gpt2),
     "decode": bench_decode,
     "flashab": bench_flash_ab,
     "longctx": bench_longctx,

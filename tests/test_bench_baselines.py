@@ -85,3 +85,50 @@ def test_no_pin_rows_are_never_pinned_or_ratioed(bench, tmp_path):
     bench._apply_baselines(rows, canonical=True, backend="cpu")
     assert _pins(tmp_path) == {}
     assert rows[0]["vs_baseline"] is None
+
+
+def test_banked_tpu_pins_reads_both_formats(bench, tmp_path):
+    (tmp_path / ".bench_baseline.json").write_text(json.dumps({"pinned": {
+        "keyed": {"cpu": 1.0, "tpu": 214852.0},
+        "transitional": {"value": 42.0, "backend": "tpu"},
+        "cpu_only": {"cpu": 3.0},
+        "transitional_cpu": {"value": 5.0, "backend": "cpu"},
+    }}))
+    assert bench._banked_tpu_pins() == {"keyed": 214852.0,
+                                        "transitional": 42.0}
+
+
+def test_banked_tpu_pins_absent_or_cpu_only_is_none(bench, tmp_path):
+    assert bench._banked_tpu_pins() is None  # no file
+    (tmp_path / ".bench_baseline.json").write_text(
+        json.dumps({"pinned": {"m": {"cpu": 1.0}}}))
+    assert bench._banked_tpu_pins() is None  # no tpu pins
+
+
+def test_flash_fallback_retries_with_xla_on_tpu(bench, monkeypatch):
+    """A Mosaic lowering failure on TPU must bank an XLA-attention row
+    (with the kernel error preserved) instead of an error row."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    calls = []
+
+    def row_fn():
+        calls.append(bench.os.environ.get("DL4J_TPU_FLASH"))
+        if len(calls) == 1:
+            raise RuntimeError("Mosaic failed to lower")
+        return {"metric": "m", "value": 1.0}
+
+    row = bench._flash_fallback(row_fn)
+    assert calls == [None, "0"]  # retry ran with flash disabled
+    assert row["attention"].startswith("xla")
+    assert "Mosaic failed to lower" in row["flash_error"]
+    assert "DL4J_TPU_FLASH" not in bench.os.environ  # env restored
+
+
+def test_flash_fallback_reraises_off_tpu(bench):
+    def row_fn():
+        raise RuntimeError("genuine CPU bug")
+
+    with pytest.raises(RuntimeError, match="genuine CPU bug"):
+        bench._flash_fallback(row_fn)

@@ -61,13 +61,29 @@ commit_paths() {
 
 stage() {
     # stage <name> <timeout_s> <env...> -- runs bench.py, logs, commits.
+    #
+    # Re-probe before every stage: when the tunnel wedges mid-harvest, a
+    # hung dial never recovers even if the tunnel later does (jax caches
+    # the failed backend per process), so without this gate each
+    # remaining stage burns its full timeout — hours of missed green
+    # windows.  A failed gate costs one probe and hands control back to
+    # the main loop, which restarts the whole value-ordered harvest on
+    # the next green probe.
     local name=$1 tmo=$2; shift 2
+    if ! probe; then
+        echo "stage $name skipped $(date -u): tunnel wedged (pre-probe)"
+        return 125
+    fi
     local log="$LOG_DIR/tpu_${name}_$(STAMP).log"
     {
         echo "== $name  $(date -u)  sha=$(git rev-parse --short HEAD)"
         env | grep -E 'BENCH_|XLA_|JAX_' || true
     } >"$log"
-    timeout "$tmo" env "$@" python bench.py >>"$log" 2>&1
+    # BENCH_CPU_FALLBACK=0: a TPU-harvest stage must bank a TPU number
+    # or an honest failure — never a load-polluted CPU fallback row
+    # committed under a "TPU harvest" message.
+    timeout "$tmo" env BENCH_CPU_FALLBACK=0 "$@" python bench.py \
+        >>"$log" 2>&1
     local rc=$?
     echo "== rc=$rc  $(date -u)" >>"$log"
     commit_paths "TPU harvest: $name (rc=$rc, watcher)" \

@@ -793,9 +793,20 @@ def _apply_baselines(results: list, canonical: bool,
     compares against a TPU pin or vice versa, and — because pins are
     keyed by backend, not overwritten on backend change — a CPU-fallback
     canonical run during a tunnel outage cannot destroy the TPU pin (the
-    next TPU run still ratios against the original TPU baseline)."""
+    next TPU run still ratios against the original TPU baseline).
+
+    CPU pins are additionally host-fingerprinted (`pin_hosts`: metric ->
+    backend -> os.cpu_count() at pin time): CPU throughput scales with
+    host cores, so a pin from an N-core box is not a baseline for an
+    M-core box.  Such rows report `vs_pin_other_host` instead of
+    `vs_baseline` and are exempt from the regression gate.  (Discovered
+    the hard way: a 1-core session read 0.41x on the Word2Vec pin from a
+    multi-core session — the background pair-producer thread and the
+    device step were fighting for the only core.)  TPU rows are
+    device-bound and never host-gated."""
     path = REPO / ".bench_baseline.json"
     pinned: dict = {}
+    pin_hosts: dict = {}
     if path.exists():
         data = json.loads(path.read_text())
         for metric, entry in data.get("pinned", {}).items():
@@ -807,7 +818,9 @@ def _apply_baselines(results: list, canonical: bool,
                 pinned[metric] = dict(entry)  # backend -> value
             else:  # legacy bare number: backend unknown
                 pinned[metric] = {"unknown": entry}
+        pin_hosts = data.get("pin_hosts", {})
     key = backend or "unknown"
+    cpus = os.cpu_count()
     changed = False
     for r in results:
         if r.get("value") is None:
@@ -831,14 +844,25 @@ def _apply_baselines(results: list, canonical: bool,
                                 and os.environ.get("BENCH_FORCE_PIN"))
         if key not in per_backend and may_pin:
             per_backend[key] = r["value"]
+            pin_hosts.setdefault(r["metric"], {})[key] = cpus
             changed = True
         # No pin for this (metric, backend) -> honest None, never a
         # self-ratio of 1.0 pretending a baseline exists.
         base = per_backend.get(key)
+        if base and key == "cpu":
+            pin_cpus = pin_hosts.get(r["metric"], {}).get(key)
+            # pin_cpus None = legacy pin (pre-fingerprint): compare as
+            # before rather than inventing a host it was measured on.
+            if pin_cpus is not None and pin_cpus != cpus:
+                r["vs_baseline"] = None
+                r["vs_pin_other_host"] = round(r["value"] / base, 3)
+                r["pin_host_cpus"] = pin_cpus
+                continue
         r["vs_baseline"] = round(r["value"] / base, 3) if base else None
     if changed:
         path.write_text(json.dumps(
-            {"pinned": pinned, "recorded": time.strftime("%Y-%m-%d")},
+            {"pinned": pinned, "pin_hosts": pin_hosts,
+             "recorded": time.strftime("%Y-%m-%d")},
             indent=1))
 
 
@@ -879,6 +903,7 @@ def run_suite() -> int:
         r["elapsed_s"] = round(time.perf_counter() - t0, 1)
         if backend is not None:
             r.setdefault("backend", backend)
+        r.setdefault("host_cpus", os.cpu_count())
         if backend != "tpu":
             # MFU against a CPU flops model is decorative (VERDICT r4
             # weak #2): keep the `mfu` key TPU-only so the eventual real

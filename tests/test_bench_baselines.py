@@ -132,3 +132,47 @@ def test_flash_fallback_reraises_off_tpu(bench):
 
     with pytest.raises(RuntimeError, match="genuine CPU bug"):
         bench._flash_fallback(row_fn)
+
+
+def test_cpu_pin_from_other_host_is_not_a_regression(bench, tmp_path,
+                                                     monkeypatch):
+    """CPU throughput scales with host cores: a pin from an N-core box
+    must not read as a perf regression on an M-core box."""
+    (tmp_path / ".bench_baseline.json").write_text(json.dumps({
+        "pinned": {"m": {"cpu": 100.0}},
+        "pin_hosts": {"m": {"cpu": 8}},
+    }))
+    monkeypatch.setattr(bench.os, "cpu_count", lambda: 1)
+    rows = [{"metric": "m", "value": 41.0}]
+    bench._apply_baselines(rows, canonical=True, backend="cpu")
+    assert rows[0]["vs_baseline"] is None
+    assert rows[0]["vs_pin_other_host"] == 0.41
+    assert rows[0]["pin_host_cpus"] == 8
+
+
+def test_legacy_cpu_pin_without_host_still_compares(bench, tmp_path):
+    (tmp_path / ".bench_baseline.json").write_text(json.dumps({
+        "pinned": {"m": {"cpu": 100.0}},
+    }))
+    rows = [{"metric": "m", "value": 90.0}]
+    bench._apply_baselines(rows, canonical=True, backend="cpu")
+    assert rows[0]["vs_baseline"] == 0.9
+
+
+def test_tpu_pins_are_never_host_gated(bench, tmp_path, monkeypatch):
+    (tmp_path / ".bench_baseline.json").write_text(json.dumps({
+        "pinned": {"m": {"tpu": 100.0}},
+        "pin_hosts": {"m": {"tpu": 8}},
+    }))
+    monkeypatch.setattr(bench.os, "cpu_count", lambda: 1)
+    rows = [{"metric": "m", "value": 99.0}]
+    bench._apply_baselines(rows, canonical=True, backend="tpu")
+    assert rows[0]["vs_baseline"] == 0.99
+
+
+def test_new_pin_records_host_cpus(bench, tmp_path, monkeypatch):
+    monkeypatch.setattr(bench.os, "cpu_count", lambda: 4)
+    bench._apply_baselines([{"metric": "m", "value": 10.0}],
+                           canonical=True, backend="cpu")
+    data = json.loads((tmp_path / ".bench_baseline.json").read_text())
+    assert data["pin_hosts"]["m"]["cpu"] == 4

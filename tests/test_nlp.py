@@ -346,3 +346,20 @@ def test_cooccurrences_vectorized_matches_bruteforce():
     assert set(got) == set(want)
     for k in want:
         assert abs(got[k] - want[k]) < 1e-9, k
+
+
+class TestWord2VecSingleCorePath:
+    def test_inline_pairgen_matches_threaded_bitwise(self, monkeypatch):
+        """On a 1-core host fit() generates pairs inline instead of on a
+        producer thread; both paths drive the same rng in the same order
+        so the trained embeddings must be BIT-identical."""
+        import os
+
+        def train(cores):
+            monkeypatch.setattr(os, "cpu_count", lambda: cores)
+            m = Word2Vec(vector_length=12, window=2, epochs=2, seed=3,
+                         negative=5, batch_size=256)
+            m.fit(CORPUS[:60])
+            return m.syn0
+
+        np.testing.assert_array_equal(train(2), train(1))

@@ -800,10 +800,10 @@ def _apply_baselines(results: list, canonical: bool,
     host cores, so a pin from an N-core box is not a baseline for an
     M-core box.  Such rows report `vs_pin_other_host` instead of
     `vs_baseline` and are exempt from the regression gate.  (Discovered
-    the hard way: a 1-core session read 0.41x on the Word2Vec pin from a
-    multi-core session — the background pair-producer thread and the
-    device step were fighting for the only core.)  TPU rows are
-    device-bound and never host-gated."""
+    the hard way: a 1-core session read Word2Vec at 0.41x its pin from a
+    multi-core session — 0.80x of it host size, the rest sibling-row
+    contention on the one core.)  TPU rows are device-bound and never
+    host-gated."""
     path = REPO / ".bench_baseline.json"
     pinned: dict = {}
     pin_hosts: dict = {}

@@ -1,7 +1,7 @@
 # Convenience entry points. Everything here is reproducible by hand —
 # the targets just spell the one-liners out.
 
-.PHONY: test dryrun bench smoke
+.PHONY: test dryrun bench smoke evidence
 
 test:
 	python -m pytest tests/ -x -q
@@ -16,3 +16,10 @@ bench:
 
 smoke:
 	BENCH_ONLY=lenet,transformer python bench.py
+
+# Regenerate every committed EVIDENCE/ artifact (see EVIDENCE/README.md).
+# Each runner re-execs itself into a scrubbed 8-virtual-CPU-device env,
+# so this is safe under a wedged TPU tunnel.
+evidence: dryrun
+	cd tools/evidence && python longctx.py && python ui_server.py \
+	  && python scaleout.py && python runtime.py && python lm_cli.py

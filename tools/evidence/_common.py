@@ -1,0 +1,86 @@
+"""Shared plumbing for the evidence runners (tools/evidence/*.py).
+
+Each runner proves one subsystem end-to-end and writes a timestamped,
+committed log to EVIDENCE/ carrying the git SHA, host fingerprint, and
+full output — the artifact class VERDICT r4 asked for ("a committed,
+timestamped, reproducible artifact, not prose").  Run them all with
+`make evidence`.
+
+Runners force the scrubbed-CPU environment themselves (mirror of
+`__graft_entry__.scrub_tpu_env`): when the axon tunnel is wedged, a
+fresh python hangs dialing it before any repo code runs, so the
+decision must be made from the environment BEFORE jax is imported.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+EVIDENCE = REPO / "EVIDENCE"
+if str(REPO) not in sys.path:  # scripts run from tools/evidence/
+    sys.path.insert(0, str(REPO))
+
+
+def ensure_cpu_mesh(n_devices: int = 8) -> None:
+    """Re-exec into a scrubbed n-device virtual CPU mesh if needed.
+
+    Mirrors `__graft_entry__.dryrun_multichip`'s parent/child decision:
+    made from env alone, before any jax import."""
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    if (os.environ.get("JAX_PLATFORMS") == "cpu"
+            and flag in os.environ.get("XLA_FLAGS", "")):
+        return
+    from __graft_entry__ import scrub_tpu_env
+
+    env = scrub_tpu_env(dict(os.environ), n_devices)
+    script = str(pathlib.Path(sys.argv[0]).resolve())
+    raise SystemExit(subprocess.run(
+        [sys.executable, script, *sys.argv[1:]], env=env,
+        cwd=REPO).returncode)
+
+
+def write_log(name: str, body: str) -> pathlib.Path:
+    EVIDENCE.mkdir(exist_ok=True)
+    sha = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                         cwd=REPO, capture_output=True,
+                         text=True).stdout.strip() or "unknown"
+    stamp = time.strftime("%Y%m%d_%H%M", time.gmtime())
+    path = EVIDENCE / f"{name}_{stamp}.log"
+    head = (f"== {name}  {time.strftime('%a %b %d %H:%M:%S UTC %Y', time.gmtime())}"
+            f"  sha={sha}\n"
+            f"host: {os.cpu_count()} cpu core(s); "
+            f"JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '')} "
+            f"XLA_FLAGS={os.environ.get('XLA_FLAGS', '')}\n"
+            f"command: python {' '.join(sys.argv)}\n")
+    path.write_text(head + body)
+    print(f"-> {path.relative_to(REPO)}")
+    return path
+
+
+@contextlib.contextmanager
+def capture():
+    """Tee stdout to both the console and the returned buffer."""
+    buf = io.StringIO()
+    real = sys.stdout
+
+    class Tee(io.TextIOBase):
+        def write(self, s):
+            real.write(s)
+            buf.write(s)
+            return len(s)
+
+        def flush(self):
+            real.flush()
+
+    sys.stdout = Tee()
+    try:
+        yield buf
+    finally:
+        sys.stdout = real

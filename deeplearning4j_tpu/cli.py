@@ -232,11 +232,15 @@ def cmd_lm(args) -> int:
         # and training silently stalls); the forward casts to bf16 on
         # TPU so the MXU runs at its native rate.
         on_tpu = jax.default_backend() == "tpu"
-        if args.preset == "gpt2-small":
-            # Byte-level flagship: 768/12/12, tied embeddings, per-block
+        if args.preset:
+            # Byte-level flagship presets (small 768/12/12, medium
+            # 1024/16/24, large 1280/20/36): tied embeddings, per-block
             # remat; -seq defaults are honored (S1024 recommended).
+            make = {"gpt2-small": tfm.gpt2_small,
+                    "gpt2-medium": tfm.gpt2_medium,
+                    "gpt2-large": tfm.gpt2_large}[args.preset]
             cfg = dataclasses.replace(
-                tfm.gpt2_small(max_len=S, dtype="float32"), vocab_size=256)
+                make(max_len=S, dtype="float32"), vocab_size=256)
         else:
             cfg = tfm.TransformerConfig(
                 vocab_size=256, d_model=args.d_model, n_heads=args.heads,
@@ -429,9 +433,11 @@ def build_parser() -> argparse.ArgumentParser:
     p_lm.add_argument("-epochs", "--epochs", type=int, default=1)
     p_lm.add_argument("-batch", "--batch", type=int, default=8)
     p_lm.add_argument("-seq", "--seq", type=int, default=128)
-    p_lm.add_argument("-preset", "--preset", choices=["gpt2-small"],
+    p_lm.add_argument("-preset", "--preset",
+                      choices=["gpt2-small", "gpt2-medium", "gpt2-large"],
                       default=None,
-                      help="flagship config preset (768/12/12, tied "
+                      help="flagship config preset (small 768/12/12, "
+                           "medium 1024/16/24, large 1280/20/36; tied "
                            "embeddings, remat) overriding -d-model/"
                            "-layers/-heads")
     p_lm.add_argument("-accum", "--accum", type=int, default=1,

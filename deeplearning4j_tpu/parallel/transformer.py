@@ -204,6 +204,27 @@ def gpt2_small(max_len: int = 1024, dtype: str = "bfloat16"
         tie_embeddings=True, remat=True)
 
 
+def gpt2_medium(max_len: int = 1024, dtype: str = "bfloat16"
+                ) -> TransformerConfig:
+    """GPT-2-medium-class config: ~355M params (1024/16/24), same
+    recipe as `gpt2_small` (tied embeddings, lane-128 vocab, remat)."""
+    return TransformerConfig(
+        vocab_size=50304, d_model=1024, n_heads=16, n_layers=24,
+        d_ff=4096, max_len=max_len, dtype=dtype, attn_bias=True,
+        tie_embeddings=True, remat=True)
+
+
+def gpt2_large(max_len: int = 1024, dtype: str = "bfloat16"
+               ) -> TransformerConfig:
+    """GPT-2-large-class config: ~774M params (1280/20/36).  At this
+    scale single-chip training needs accum+remat headroom; the dp/sp/tp
+    mesh trainers are the intended path."""
+    return TransformerConfig(
+        vocab_size=50304, d_model=1280, n_heads=20, n_layers=36,
+        d_ff=5120, max_len=max_len, dtype=dtype, attn_bias=True,
+        tie_embeddings=True, remat=True)
+
+
 def _layer_norm(p, x, eps=1e-5):
     mu = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)

@@ -767,3 +767,34 @@ def test_bf16_compute_keeps_f32_master_params():
         a.dtype, jnp.floating)
         for a in jax.tree_util.tree_leaves(
             (pipe.stage_params, pipe.io_params)))
+
+
+class TestFlagshipPresets:
+    """Param-count sanity for the GPT-2-class presets via jax.eval_shape
+    (counts shapes without materializing 355M/774M floats)."""
+
+    @pytest.mark.parametrize("maker,lo,hi", [
+        ("gpt2_small", 120e6, 130e6),
+        ("gpt2_medium", 345e6, 365e6),
+        ("gpt2_large", 760e6, 790e6),
+    ])
+    def test_param_counts(self, maker, lo, hi):
+        import numpy as _np
+
+        from deeplearning4j_tpu.parallel import transformer as tfm
+
+        cfg = getattr(tfm, maker)(max_len=64)
+        shapes = jax.eval_shape(
+            lambda k: tfm.init_params(cfg, k), jax.random.PRNGKey(0))
+        n = sum(int(_np.prod(l.shape))
+                for l in jax.tree_util.tree_leaves(shapes))
+        assert lo <= n <= hi, (maker, n)
+        assert cfg.tie_embeddings and cfg.remat
+
+    def test_cli_accepts_new_presets(self):
+        from deeplearning4j_tpu.cli import build_parser
+
+        p = build_parser()
+        for preset in ("gpt2-small", "gpt2-medium", "gpt2-large"):
+            args = p.parse_args(["lm", "-preset", preset])
+            assert args.preset == preset

@@ -22,4 +22,5 @@ smoke:
 # so this is safe under a wedged TPU tunnel.
 evidence: dryrun
 	cd tools/evidence && python longctx.py && python ui_server.py \
-	  && python scaleout.py && python runtime.py && python lm_cli.py
+	  && python scaleout.py && python runtime.py && python nlp.py \
+	  && python analysis.py && python lm_cli.py

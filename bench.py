@@ -785,6 +785,31 @@ EXPLICIT_ONLY = {"gpt2mem"}
 # baseline pinning
 # ---------------------------------------------------------------------------
 
+def _load_pin_file() -> tuple:
+    """Single source of truth for the .bench_baseline.json schema.
+
+    Returns (pinned: metric -> {backend: value}, pin_hosts: metric ->
+    {backend: cpu_count}).  Normalizes the two historical formats — the
+    transitional single-slot {value, backend} entry and legacy bare
+    numbers (backend unknown) — so no other reader re-implements this."""
+    path = REPO / ".bench_baseline.json"
+    pinned: dict = {}
+    pin_hosts: dict = {}
+    if path.exists():
+        data = json.loads(path.read_text())
+        for metric, entry in data.get("pinned", {}).items():
+            if isinstance(entry, dict) and "value" in entry:
+                # transitional single-slot {value, backend} format
+                pinned[metric] = {entry.get("backend") or "unknown":
+                                  entry["value"]}
+            elif isinstance(entry, dict):
+                pinned[metric] = dict(entry)  # backend -> value
+            else:  # legacy bare number: backend unknown
+                pinned[metric] = {"unknown": entry}
+        pin_hosts = data.get("pin_hosts", {})
+    return pinned, pin_hosts
+
+
 def _apply_baselines(results: list, canonical: bool,
                      backend: str = None) -> None:
     """Pin per-(metric, backend) baselines and fill vs_baseline.
@@ -805,20 +830,7 @@ def _apply_baselines(results: list, canonical: bool,
     contention on the one core.)  TPU rows are device-bound and never
     host-gated."""
     path = REPO / ".bench_baseline.json"
-    pinned: dict = {}
-    pin_hosts: dict = {}
-    if path.exists():
-        data = json.loads(path.read_text())
-        for metric, entry in data.get("pinned", {}).items():
-            if isinstance(entry, dict) and "value" in entry:
-                # transitional single-slot {value, backend} format
-                pinned[metric] = {entry.get("backend") or "unknown":
-                                  entry["value"]}
-            elif isinstance(entry, dict):
-                pinned[metric] = dict(entry)  # backend -> value
-            else:  # legacy bare number: backend unknown
-                pinned[metric] = {"unknown": entry}
-        pin_hosts = data.get("pin_hosts", {})
+    pinned, pin_hosts = _load_pin_file()
     key = backend or "unknown"
     cpus = os.cpu_count()
     changed = False
@@ -973,25 +985,20 @@ def _cpu_scrubbed_env(env: dict) -> dict:
     return scrub_tpu_env(env)
 
 
-def _banked_tpu_pins():
-    """Real-TPU first-pin values banked by a previous green tunnel window
-    (committed in .bench_baseline.json under the 'tpu' backend key).
-    Surfaced on the CPU-fallback record so a wedged-tunnel round still
-    carries the framework's real-TPU evidence in its one JSON line."""
+def _attach_banked_tpu_pins(record: dict) -> dict:
+    """Attach real-TPU first-pin values banked by a previous green tunnel
+    window (the 'tpu' backend slots in .bench_baseline.json) so a
+    wedged-tunnel round still carries the framework's real-TPU evidence
+    in its one JSON line."""
     try:
-        data = json.loads((REPO / ".bench_baseline.json").read_text())
-        pins = {}
-        for m, e in data.get("pinned", {}).items():
-            if not isinstance(e, dict):
-                continue
-            if "value" in e:  # transitional single-slot {value, backend}
-                if e.get("backend") == "tpu":
-                    pins[m] = e["value"]
-            elif "tpu" in e:  # backend-keyed format
-                pins[m] = e["tpu"]
-        return pins or None
+        pinned, _ = _load_pin_file()
     except (OSError, ValueError):
-        return None
+        return record
+    banked = {m: slots["tpu"] for m, slots in pinned.items()
+              if "tpu" in slots}
+    if banked:
+        record["tpu_rows_banked"] = banked
+    return record
 
 
 def _first_json_line(text: str):
@@ -1122,19 +1129,13 @@ def main() -> int:
                 # a CPU number ratioed against a TPU-pinned baseline would
                 # read as a perf regression; don't compare across backends
                 record["vs_baseline"] = None
-                banked = _banked_tpu_pins()
-                if banked:
-                    record["tpu_rows_banked"] = banked
-                print(json.dumps(record))
+                print(json.dumps(_attach_banked_tpu_pins(record)))
                 return 0
-    out = {"metric": RECORD_METRIC, "value": None,
-           "unit": "examples/sec", "vs_baseline": None,
-           "error": f"all {RETRIES} attempts failed; last: "
-                    f"{last_tail[:500]}"}
-    banked = _banked_tpu_pins()
-    if banked:
-        out["tpu_rows_banked"] = banked
-    print(json.dumps(out))
+    print(json.dumps(_attach_banked_tpu_pins(
+        {"metric": RECORD_METRIC, "value": None,
+         "unit": "examples/sec", "vs_baseline": None,
+         "error": f"all {RETRIES} attempts failed; last: "
+                  f"{last_tail[:500]}"})))
     return 1
 
 

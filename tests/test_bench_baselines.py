@@ -94,15 +94,16 @@ def test_banked_tpu_pins_reads_both_formats(bench, tmp_path):
         "cpu_only": {"cpu": 3.0},
         "transitional_cpu": {"value": 5.0, "backend": "cpu"},
     }}))
-    assert bench._banked_tpu_pins() == {"keyed": 214852.0,
-                                        "transitional": 42.0}
+    rec = bench._attach_banked_tpu_pins({"metric": "m"})
+    assert rec["tpu_rows_banked"] == {"keyed": 214852.0,
+                                      "transitional": 42.0}
 
 
-def test_banked_tpu_pins_absent_or_cpu_only_is_none(bench, tmp_path):
-    assert bench._banked_tpu_pins() is None  # no file
+def test_banked_tpu_pins_absent_or_cpu_only_omits_key(bench, tmp_path):
+    assert "tpu_rows_banked" not in bench._attach_banked_tpu_pins({})
     (tmp_path / ".bench_baseline.json").write_text(
         json.dumps({"pinned": {"m": {"cpu": 1.0}}}))
-    assert bench._banked_tpu_pins() is None  # no tpu pins
+    assert "tpu_rows_banked" not in bench._attach_banked_tpu_pins({})
 
 
 def test_flash_fallback_retries_with_xla_on_tpu(bench, monkeypatch):

@@ -1,0 +1,82 @@
+"""Hybrid-parallel training evidence: the SAME byte-LM task trained to
+decreasing loss on BOTH multichip layouts — dp/sp/tp (GSPMD + ring
+attention) on a (2,2,2) mesh and dp/pp (GPipe scan+ppermute) on a (2,4)
+mesh — with a single-device oracle trained on identical data for
+comparison.  One-step parity lives in tests/test_parallel_extended.py
+and the dryrun logs; this artifact shows real multi-step optimization
+on both meshes (`SparkDl4jMultiLayer.java:182-202` and the Akka tier
+are the reference stakes; the mesh layouts are the TPU-first redesign)."""
+
+from _common import capture, ensure_cpu_mesh, write_log
+
+ensure_cpu_mesh(8)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.parallel import make_mesh  # noqa: E402
+from deeplearning4j_tpu.parallel import transformer as tfm  # noqa: E402
+from deeplearning4j_tpu.parallel.hybrid import (  # noqa: E402
+    HybridParallelTrainer,
+    PipelineParallelTrainer,
+    make_accum_train_step,
+)
+
+STEPS = 30
+
+
+def _data(cfg, n, seed):
+    """Byte-LM batches from a repeating structured pattern (learnable)."""
+    rng = np.random.default_rng(seed)
+    base = np.arange(cfg.max_len) % 17 + 1
+    toks = np.stack([np.roll(base, rng.integers(0, 17)) for _ in range(n)])
+    tgts = np.roll(toks, -1, axis=1)
+    return toks.astype(np.int32), tgts.astype(np.int32)
+
+
+def main() -> None:
+    devs = jax.devices()
+    print(f"devices: {len(devs)} ({jax.default_backend()})")
+    cfg = tfm.TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                                n_layers=4, d_ff=64, max_len=16)
+    tokens, targets = _data(cfg, 8, seed=1)
+
+    print(f"== single-device Adam oracle, {STEPS} steps")
+    step, init_state = make_accum_train_step(cfg, lr=3e-3, accum=1,
+                                             updater="adam")
+    p = tfm.init_params(cfg, jax.random.PRNGKey(3))
+    state = init_state(p)
+    tok_d, tgt_d = jnp.asarray(tokens), jnp.asarray(targets)
+    oracle = []
+    for _ in range(STEPS):
+        p, state, loss = step(p, state, tok_d, tgt_d)
+        oracle.append(float(loss))
+    print(f"oracle loss: {oracle[0]:.4f} -> {oracle[-1]:.4f}")
+
+    print(f"== dp/sp/tp mesh=(2,2,2), Adam, {STEPS} steps")
+    mesh = make_mesh((2, 2, 2), ("data", "seq", "model"), devices=devs[:8])
+    tr = HybridParallelTrainer(cfg, mesh, lr=3e-3, seed=3, updater="adam")
+    h_losses = [tr.fit_batch(tokens, targets) for _ in range(STEPS)]
+    print(f"hybrid loss: {h_losses[0]:.4f} -> {h_losses[-1]:.4f} "
+          f"(matches oracle to "
+          f"{max(abs(a - b) for a, b in zip(h_losses, oracle)):.1e})")
+    assert h_losses[-1] < h_losses[0] * 0.8
+
+    print(f"== dp/pp mesh=(2,4), GPipe microbatches=2, Adam, {STEPS} steps")
+    mesh_pp = make_mesh((2, 4), ("data", "stage"), devices=devs[:8])
+    tr_pp = PipelineParallelTrainer(cfg, mesh_pp, n_microbatches=2,
+                                    lr=3e-3, seed=3, updater="adam")
+    p_losses = [tr_pp.fit_batch(tokens, targets) for _ in range(STEPS)]
+    print(f"pipeline loss: {p_losses[0]:.4f} -> {p_losses[-1]:.4f} "
+          f"(matches oracle to "
+          f"{max(abs(a - b) for a, b in zip(p_losses, oracle)):.1e})")
+    assert p_losses[-1] < p_losses[0] * 0.8
+    print("GREEN: both multichip layouts train the same task to "
+          "decreasing loss, tracking the single-device oracle")
+
+
+if __name__ == "__main__":
+    with capture() as buf:
+        main()
+    write_log("hybrid_training", buf.getvalue())

@@ -92,6 +92,7 @@ stage() {
 }
 
 echo "watcher armed $(date -u); probing every ${SLEEP_S}s"
+FAILED=0
 while :; do
     if probe; then
         echo "GREEN $(date -u) — harvesting"
@@ -109,6 +110,14 @@ while :; do
         stage canonical   5400 BENCH_ATTEMPT_TIMEOUT=5400
         echo "harvest complete $(date -u); watcher continues"
         touch /tmp/tpu_harvest_done
+        FAILED=0
+    else
+        # Document the outage: one line per 20 hung probes, so the log
+        # itself shows the tunnel was down (not that nobody was watching).
+        FAILED=$((FAILED + 1))
+        if [ $((FAILED % 20)) -eq 0 ]; then
+            echo "still wedged $(date -u): $FAILED consecutive probes hung"
+        fi
     fi
     sleep "$SLEEP_S"
 done

@@ -183,6 +183,76 @@ def cmd_train(args) -> int:
     return 0
 
 
+def _lm_mesh_train(args, cfg, ids, B, S):
+    """Train the byte LM on a multi-device mesh runtime and return the
+    gathered host params (standard `init_params` tree layout).
+
+    -runtime hybrid: dp/sp/tp via GSPMD + ring attention (the
+    dp/sp/tp/ep tier); -runtime pipeline: dp/pp GPipe.  The visible
+    devices are factorized into the layout; divisibility constraints
+    fail with actionable messages."""
+    import time
+
+    import jax
+
+    from deeplearning4j_tpu.parallel import make_mesh
+    from deeplearning4j_tpu.parallel.hybrid import (
+        HybridParallelTrainer,
+        PipelineParallelTrainer,
+    )
+
+    n = len(jax.devices())
+    if args.accum > 1:
+        print("-accum is a local-runtime feature; ignored under mesh "
+              "runtimes")
+    if args.runtime == "hybrid":
+        sp = 2 if n % 2 == 0 and S % 2 == 0 else 1
+        tp = 2 if (n // sp) % 2 == 0 and cfg.n_heads % 2 == 0 else 1
+        dp = max(1, n // (sp * tp))
+        used = dp * sp * tp
+        if B % dp:
+            B += dp - B % dp
+            print(f"hybrid: -batch rounded up to {B} ({dp} data shards)")
+        mesh = make_mesh((dp, sp, tp), ("data", "seq", "model"),
+                         devices=jax.devices()[:used])
+        trainer = HybridParallelTrainer(cfg, mesh, lr=args.lr, seed=0,
+                                        updater=args.updater)
+        layout = f"dp{dp}/sp{sp}/tp{tp} over {used} devices"
+    else:
+        stages = next((s for s in (4, 2)
+                       if n % s == 0 and cfg.n_layers % s == 0), None)
+        if stages is None:
+            raise SystemExit(
+                f"pipeline: need n_layers ({cfg.n_layers}) and device "
+                f"count ({n}) both divisible by 2 or 4 stages")
+        dp = n // stages
+        if B % dp:
+            B += dp - B % dp
+            print(f"pipeline: -batch rounded up to {B} ({dp} data shards)")
+        mb = 2 if (B // dp) % 2 == 0 else 1
+        mesh = make_mesh((dp, stages), ("data", "stage"),
+                         devices=jax.devices()[:n])
+        trainer = PipelineParallelTrainer(cfg, mesh, n_microbatches=mb,
+                                          lr=args.lr, seed=0,
+                                          updater=args.updater)
+        layout = f"dp{dp}/pp{stages} (microbatches={mb})"
+    print(f"{args.runtime}: training on mesh {layout}")
+    rng = np.random.default_rng(0)
+    steps = max(1, args.epochs * (len(ids) // max(B * S, 1)))
+    t0, loss = time.time(), None
+    for k in range(steps):
+        starts = rng.integers(0, len(ids) - S - 1, B)
+        tokens = np.stack([ids[s:s + S] for s in starts])
+        targets = np.stack([ids[s + 1:s + S + 1] for s in starts])
+        loss = trainer.fit_batch(tokens, targets)
+        if args.verbose and (k + 1) % 20 == 0:
+            print(f"step {k + 1}/{steps} loss {loss:.4f}")
+    tok_rate = steps * B * S / max(time.time() - t0, 1e-9)
+    print(f"Trained {steps} steps (final loss {loss:.4f}, "
+          f"{tok_rate:.0f} tokens/sec)")
+    return trainer.export_params()
+
+
 def cmd_lm(args) -> int:
     """Train the flagship TransformerLM on a raw text file (byte-level
     vocab, causal LM) and/or generate from a saved one — the CLI surface
@@ -245,6 +315,15 @@ def cmd_lm(args) -> int:
             cfg = tfm.TransformerConfig(
                 vocab_size=256, d_model=args.d_model, n_heads=args.heads,
                 n_layers=args.layers, d_ff=4 * args.d_model, max_len=S)
+        if args.runtime in ("hybrid", "pipeline"):
+            # Mesh runtimes own init (seed 0) and the whole train loop;
+            # control falls through to the shared eval/generate tail
+            # with the gathered host params.
+            params = _lm_mesh_train(args, cfg, ids, B, S)
+            save(cfg, params)
+            print(f"LM saved to {out}")
+            return _lm_tail(args, cfg, params)
+
         params = _master_f32(tfm.init_params(cfg, jax.random.PRNGKey(0)))
         compute_cfg = (dataclasses.replace(cfg, dtype="bfloat16")
                        if on_tpu else cfg)
@@ -304,6 +383,17 @@ def cmd_lm(args) -> int:
         if not cfg_path.exists():
             raise SystemExit(f"no -input and no saved LM at {out}")
         cfg, params = load()
+
+    return _lm_tail(args, cfg, params)
+
+
+def _lm_tail(args, cfg, params) -> int:
+    """Shared -eval / -generate tail for every lm runtime."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel import transformer as tfm
+    from deeplearning4j_tpu.parallel.generation import generate
 
     if args.eval is not None:
         # Held-out byte-level perplexity: mean NLL over non-overlapping
@@ -470,8 +560,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_lm.add_argument("-gen-seed", "--gen-seed", dest="gen_seed", type=int,
                       default=0)
     p_lm.add_argument("-runtime", "--runtime",
-                      choices=["local", "spmd"], default="local",
-                      help="spmd = data-parallel over all devices (GSPMD)")
+                      choices=["local", "spmd", "hybrid", "pipeline"],
+                      default="local",
+                      help="spmd = data-parallel over all devices "
+                           "(GSPMD); hybrid = dp/sp/tp mesh (GSPMD + "
+                           "ring attention); pipeline = dp/pp GPipe "
+                           "stages")
     p_lm.add_argument("-verbose", "--verbose", action="store_true")
     p_lm.set_defaults(fn=cmd_lm)
 

@@ -188,6 +188,13 @@ class HybridParallelTrainer:
             self.params, self.opt_state, tokens, targets)
         return float(loss)
 
+    def export_params(self) -> dict:
+        """Gathered host copy of the params in the standard
+        `transformer.init_params` layout (for checkpointing/generation)."""
+        import numpy as np
+
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
 
 class PipelineParallelTrainer:
     """dp x pp training: transformer blocks sharded over `stage`."""
@@ -359,3 +366,20 @@ class PipelineParallelTrainer:
          loss) = self._step(self.stage_params, self.io_params,
                             self.stage_opt, self.io_opt, tokens, targets)
         return float(loss)
+
+    def export_params(self) -> dict:
+        """Gathered host copy in the standard `transformer.init_params`
+        layout: the [n_stages, layers_per_stage, ...] stacked leaves
+        unstack back into the list-of-layer-dicts tree (for
+        checkpointing/generation)."""
+        import numpy as np
+
+        stacked = jax.tree_util.tree_map(np.asarray, self.stage_params)
+        n_layers = self.cfg.n_layers
+        flat = jax.tree_util.tree_map(
+            lambda a: a.reshape((n_layers,) + a.shape[2:]), stacked)
+        out = {k: jax.tree_util.tree_map(np.asarray, v)
+               for k, v in self.io_params.items()}
+        out["layers"] = [jax.tree_util.tree_map(lambda a: a[i], flat)
+                         for i in range(n_layers)]
+        return out

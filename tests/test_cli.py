@@ -237,3 +237,29 @@ def test_train_runs_greedy_pretraining_for_dbn(tmp_path, capsys,
                "-batch", "32"])
     assert rc == 0
     assert calls, "CLI train must run greedy pretraining for pretrain confs"
+
+
+def test_lm_mesh_runtimes_match_each_other(tmp_path, capsys):
+    """`-runtime hybrid` (dp/sp/tp) and `-runtime pipeline` (dp/pp) both
+    train end-to-end through the CLI on the 8-device mesh, save in the
+    standard layout, and — same seed, same data order — land on the
+    same final loss."""
+    text = tmp_path / "corpus.txt"
+    text.write_text("the quick brown fox jumps over the lazy dog. " * 60)
+    finals = {}
+    for runtime in ("hybrid", "pipeline"):
+        out = tmp_path / f"lm_{runtime}"
+        rc = main(["lm", "-input", str(text), "-output", str(out),
+                   "-epochs", "1", "-batch", "8", "-seq", "16",
+                   "-d-model", "32", "-layers", "4", "-heads", "4",
+                   "-lr", "3e-3", "-runtime", runtime,
+                   "-generate", "the", "-max-new", "4",
+                   "-temperature", "0"])
+        assert rc == 0
+        assert (out / "lm_params.npz").exists()
+        got = capsys.readouterr().out
+        assert f"{runtime}: training on mesh" in got
+        finals[runtime] = float(
+            got.split("final loss ")[1].split(",")[0])
+    assert finals["hybrid"] == pytest.approx(finals["pipeline"],
+                                             abs=1e-3)

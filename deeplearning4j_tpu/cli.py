@@ -315,6 +315,16 @@ def cmd_lm(args) -> int:
             cfg = tfm.TransformerConfig(
                 vocab_size=256, d_model=args.d_model, n_heads=args.heads,
                 n_layers=args.layers, d_ff=4 * args.d_model, max_len=S)
+        if args.experts:
+            if args.runtime == "pipeline":
+                # Documented boundary (PARITY): MoE rides the dp/sp/tp/ep
+                # mesh; pipeline stages are dense-MLP only.
+                raise SystemExit(
+                    "-experts is not supported under -runtime pipeline; "
+                    "use -runtime hybrid (expert parallelism rides the "
+                    "model axis) or local/spmd")
+            cfg = dataclasses.replace(cfg, n_experts=args.experts,
+                                      moe_top_k=args.moe_top_k)
         if args.runtime in ("hybrid", "pipeline"):
             # Mesh runtimes own init (seed 0) and the whole train loop;
             # control falls through to the shared eval/generate tail
@@ -532,6 +542,13 @@ def build_parser() -> argparse.ArgumentParser:
                            "-layers/-heads")
     p_lm.add_argument("-accum", "--accum", type=int, default=1,
                       help="gradient-accumulation microbatches per step")
+    p_lm.add_argument("-experts", "--experts", type=int, default=0,
+                      help="MoE experts per block (0 = dense MLP; "
+                           "Switch/top-k routing with capacity dispatch "
+                           "in training, dense-masked at inference)")
+    p_lm.add_argument("-moe-top-k", "--moe-top-k", type=int, default=1,
+                      help="experts routed per token (1 = Switch, "
+                           "2 = GShard-style)")
     p_lm.add_argument("-d-model", "--d-model", dest="d_model", type=int,
                       default=128)
     p_lm.add_argument("-layers", "--layers", type=int, default=2)

@@ -263,3 +263,25 @@ def test_lm_mesh_runtimes_match_each_other(tmp_path, capsys):
             got.split("final loss ")[1].split(",")[0])
     assert finals["hybrid"] == pytest.approx(finals["pipeline"],
                                              abs=1e-3)
+
+
+def test_lm_moe_experts_flag(tmp_path, capsys):
+    """-experts trains a Switch-MoE byte LM end-to-end (train -> save ->
+    generate), and the pipeline runtime rejects it with the documented
+    boundary message."""
+    text = tmp_path / "corpus.txt"
+    text.write_text("the quick brown fox jumps over the lazy dog. " * 40)
+    out = tmp_path / "lm_moe"
+    rc = main(["lm", "-input", str(text), "-output", str(out),
+               "-epochs", "1", "-batch", "4", "-seq", "16",
+               "-d-model", "32", "-layers", "2", "-heads", "4",
+               "-experts", "2", "-generate", "the", "-max-new", "4",
+               "-temperature", "0"])
+    assert rc == 0
+    assert (out / "lm_params.npz").exists()
+    cfg = json.loads((out / "lm_config.json").read_text())
+    assert cfg["n_experts"] == 2
+    capsys.readouterr()
+    with pytest.raises(SystemExit, match="pipeline"):
+        main(["lm", "-input", str(text), "-output", str(out),
+              "-experts", "2", "-runtime", "pipeline"])

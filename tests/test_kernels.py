@@ -50,6 +50,29 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5)
 
+    def test_bf16_inputs_forward_and_grads(self):
+        """bf16 q/k/v — the dtype the TPU bench rows actually run.  The
+        kernel upcasts to f32 internally and stores bf16 outputs, so it
+        should track the f32 oracle to bf16 resolution (~1e-2)."""
+        q32, k32, v32 = _qkv(s=32, d=16, seed=3)
+        q, k, v = (x.astype(jnp.bfloat16) for x in (q32, k32, v32))
+        out = flash_attention(q, k, v, True)
+        assert out.dtype == jnp.bfloat16
+        want = attention(q32, k32, v32, causal=True)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(want), atol=2e-2)
+        grads = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, True).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        ref = jax.grad(
+            lambda q, k, v: jnp.sum(attention(q, k, v, causal=True) ** 2),
+            argnums=(0, 1, 2))(q32, k32, v32)
+        for a, b in zip(grads, ref):
+            assert a.dtype == jnp.bfloat16
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b), atol=0.15, rtol=0.1)
+
     @pytest.mark.parametrize("causal,s", [(True, 64), (False, 64),
                                           (True, 24), (False, 40)])
     def test_fused_backward_matches_dense(self, causal, s):

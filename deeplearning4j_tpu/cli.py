@@ -183,6 +183,29 @@ def cmd_train(args) -> int:
     return 0
 
 
+def _lm_mesh_layout(runtime: str, n: int, S: int, n_heads: int,
+                    n_layers: int, B: int):
+    """Pure layout choice for the lm mesh runtimes (unit-tested).
+
+    Returns (mesh_shape, rounded_B, n_microbatches|None).  Every factor
+    degrades to 1, so the same command works from one real chip up to a
+    full slice — on n=1 both runtimes become plain local training."""
+    if runtime == "hybrid":
+        sp = 2 if n % 2 == 0 and S % 2 == 0 else 1
+        tp = 2 if (n // sp) % 2 == 0 and n_heads % 2 == 0 else 1
+        dp = max(1, n // (sp * tp))
+        if B % dp:
+            B += dp - B % dp
+        return (dp, sp, tp), B, None
+    stages = next((s for s in (4, 2, 1)
+                   if n % s == 0 and n_layers % s == 0), 1)
+    dp = max(1, n // stages)
+    if B % dp:
+        B += dp - B % dp
+    mb = 2 if (B // dp) % 2 == 0 else 1
+    return (dp, stages), B, mb
+
+
 def _lm_mesh_train(args, cfg, ids, B, S):
     """Train the byte LM on a multi-device mesh runtime and return the
     gathered host params (standard `init_params` tree layout).
@@ -205,33 +228,24 @@ def _lm_mesh_train(args, cfg, ids, B, S):
     if args.accum > 1:
         print("-accum is a local-runtime feature; ignored under mesh "
               "runtimes")
+    shape, B_new, mb = _lm_mesh_layout(args.runtime, n, S, cfg.n_heads,
+                                       cfg.n_layers, B)
+    if B_new != B:
+        print(f"{args.runtime}: -batch rounded up to {B_new} "
+              f"({shape[0]} data shards)")
+        B = B_new
+    used = int(np.prod(shape))
     if args.runtime == "hybrid":
-        sp = 2 if n % 2 == 0 and S % 2 == 0 else 1
-        tp = 2 if (n // sp) % 2 == 0 and cfg.n_heads % 2 == 0 else 1
-        dp = max(1, n // (sp * tp))
-        used = dp * sp * tp
-        if B % dp:
-            B += dp - B % dp
-            print(f"hybrid: -batch rounded up to {B} ({dp} data shards)")
-        mesh = make_mesh((dp, sp, tp), ("data", "seq", "model"),
+        dp, sp, tp = shape
+        mesh = make_mesh(shape, ("data", "seq", "model"),
                          devices=jax.devices()[:used])
         trainer = HybridParallelTrainer(cfg, mesh, lr=args.lr, seed=0,
                                         updater=args.updater)
         layout = f"dp{dp}/sp{sp}/tp{tp} over {used} devices"
     else:
-        stages = next((s for s in (4, 2)
-                       if n % s == 0 and cfg.n_layers % s == 0), None)
-        if stages is None:
-            raise SystemExit(
-                f"pipeline: need n_layers ({cfg.n_layers}) and device "
-                f"count ({n}) both divisible by 2 or 4 stages")
-        dp = n // stages
-        if B % dp:
-            B += dp - B % dp
-            print(f"pipeline: -batch rounded up to {B} ({dp} data shards)")
-        mb = 2 if (B // dp) % 2 == 0 else 1
-        mesh = make_mesh((dp, stages), ("data", "stage"),
-                         devices=jax.devices()[:n])
+        dp, stages = shape
+        mesh = make_mesh(shape, ("data", "stage"),
+                         devices=jax.devices()[:used])
         trainer = PipelineParallelTrainer(cfg, mesh, n_microbatches=mb,
                                           lr=args.lr, seed=0,
                                           updater=args.updater)

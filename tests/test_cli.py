@@ -285,3 +285,43 @@ def test_lm_moe_experts_flag(tmp_path, capsys):
     with pytest.raises(SystemExit, match="pipeline"):
         main(["lm", "-input", str(text), "-output", str(out),
               "-experts", "2", "-runtime", "pipeline"])
+
+
+def test_lm_mesh_layout_factorization():
+    """The layout chooser must produce a valid mesh for ANY device count
+    — in particular n=1 (the single real TPU chip) must degrade both
+    runtimes to a trivial mesh instead of erroring."""
+    from deeplearning4j_tpu.cli import _lm_mesh_layout
+
+    for n in (1, 2, 3, 4, 6, 8, 16):
+        shape, B, _ = _lm_mesh_layout("hybrid", n, S=16, n_heads=4,
+                                      n_layers=4, B=8)
+        dp, sp, tp = shape
+        assert dp * sp * tp <= n and B % dp == 0
+        assert 16 % sp == 0 and 4 % tp == 0
+        shape, B, mb = _lm_mesh_layout("pipeline", n, S=16, n_heads=4,
+                                       n_layers=4, B=8)
+        dp, stages = shape
+        assert dp * stages <= n and 4 % stages == 0
+        assert B % dp == 0 and (B // dp) % mb == 0
+    # n=1 degrades to the trivial mesh for both
+    assert _lm_mesh_layout("hybrid", 1, 16, 4, 4, 8)[0] == (1, 1, 1)
+    assert _lm_mesh_layout("pipeline", 1, 16, 4, 4, 8)[0] == (1, 1)
+    # odd layer counts still find a stage split (or degrade to 1)
+    assert _lm_mesh_layout("pipeline", 8, 16, 4, 3, 8)[0] == (8, 1)
+
+
+def test_lm_mesh_runtime_single_device(tmp_path, monkeypatch):
+    """-runtime pipeline on ONE visible device (the real-chip case) must
+    train rather than error."""
+    import jax
+
+    real = jax.devices
+    monkeypatch.setattr(jax, "devices", lambda *a: real(*a)[:1])
+    text = tmp_path / "c.txt"
+    text.write_text("abcd " * 200)
+    rc = main(["lm", "-input", str(text), "-output",
+               str(tmp_path / "lm1"), "-epochs", "1", "-batch", "4",
+               "-seq", "16", "-d-model", "32", "-layers", "4",
+               "-heads", "4", "-runtime", "pipeline"])
+    assert rc == 0

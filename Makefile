@@ -23,4 +23,4 @@ smoke:
 evidence: dryrun
 	cd tools/evidence && python longctx.py && python ui_server.py \
 	  && python scaleout.py && python runtime.py && python nlp.py \
-	  && python analysis.py && python profiling.py && python hybrid_training.py && python lm_cli.py
+	  && python analysis.py && python profiling.py && python hybrid_training.py && python moe.py && python lm_cli.py

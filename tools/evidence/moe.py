@@ -1,0 +1,69 @@
+"""MoE evidence: Switch (top-1) and GShard-style top-2 routing with
+capacity dispatch + aux load-balancing loss, trained on the dp/sp/tp/ep
+mesh (expert parallelism rides the model axis) AND through the CLI
+`-experts` flag — the beyond-reference tier PARITY row 68 describes."""
+
+from _common import REPO, capture, ensure_cpu_mesh, write_log
+
+ensure_cpu_mesh(8)
+
+import dataclasses  # noqa: E402
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+import tempfile  # noqa: E402
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.parallel import make_mesh  # noqa: E402
+from deeplearning4j_tpu.parallel import transformer as tfm  # noqa: E402
+from deeplearning4j_tpu.parallel.hybrid import (  # noqa: E402
+    HybridParallelTrainer,
+)
+
+
+def _data(cfg, n, seed):
+    rng = np.random.default_rng(seed)
+    base = np.arange(cfg.max_len) % 13 + 1
+    toks = np.stack([np.roll(base, rng.integers(0, 13)) for _ in range(n)])
+    return toks.astype(np.int32), np.roll(toks, -1, axis=1).astype(np.int32)
+
+
+def main() -> None:
+    print(f"devices: {len(jax.devices())} ({jax.default_backend()})")
+    for top_k, name in ((1, "Switch top-1"), (2, "GShard top-2")):
+        cfg = tfm.TransformerConfig(
+            vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_len=16, n_experts=4, moe_top_k=top_k)
+        mesh = make_mesh((2, 2, 2), ("data", "seq", "model"),
+                         devices=jax.devices()[:8])
+        tr = HybridParallelTrainer(cfg, mesh, lr=3e-3, seed=0,
+                                   updater="adam")
+        toks, tgts = _data(cfg, 8, seed=2)
+        losses = [tr.fit_batch(toks, tgts) for _ in range(25)]
+        print(f"{name} (4 experts, capacity dispatch, aux loss) on "
+              f"dp/sp/tp/ep mesh: loss {losses[0]:.4f} -> {losses[-1]:.4f}")
+        assert losses[-1] < losses[0] * 0.8, (name, losses)
+
+    print("== CLI: dl4j lm -experts 2 end-to-end")
+    tmp = tempfile.mkdtemp()
+    corpus = f"{tmp}/c.txt"
+    open(corpus, "w").write("the quick brown fox jumps the lazy dog. " * 60)
+    proc = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.cli", "lm",
+         "-input", corpus, "-output", f"{tmp}/lm", "-epochs", "1",
+         "-batch", "4", "-seq", "16", "-d-model", "32", "-layers", "2",
+         "-heads", "4", "-experts", "2", "-generate", "the",
+         "-max-new", "6", "-temperature", "0"],
+        capture_output=True, text=True, cwd=REPO, timeout=900)
+    for line in proc.stdout.splitlines():
+        if "Platform" not in line:
+            print(line)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    print("GREEN: MoE routing trains on the ep mesh and through the CLI")
+
+
+if __name__ == "__main__":
+    with capture() as buf:
+        main()
+    write_log("moe", buf.getvalue())

@@ -65,6 +65,36 @@ def main() -> None:
     print("GREEN: ring-flash @S=4096 sharded 8 ways matches the dense "
           "oracle fwd+bwd")
 
+    # Leg 2: S=16384 — the bench_longctx length.  A global dense oracle
+    # would materialize [16384, 16384] scores, so the reference here is
+    # the ring schedule with the DENSE per-hop inner (exact blockwise
+    # softmax-merge), which the flash inner must match.
+    from deeplearning4j_tpu.parallel.ring_attention import ring_attention
+
+    S2 = 16384
+    q2, k2, v2 = (jnp.asarray(
+        rng.standard_normal((1, S2, 2, 32)), jnp.float32) for _ in range(3))
+
+    def make(fn):
+        return jax.jit(shard_map(
+            lambda q, k, v: fn(q, k, v, "seq", causal=True), mesh=mesh,
+            in_specs=(P(None, "seq"),) * 3, out_specs=P(None, "seq"),
+            check_rep=False))
+
+    rf, rd = make(ring_flash_attention), make(ring_attention)
+    t0 = time.perf_counter()
+    out_f = jax.block_until_ready(rf(q2, k2, v2))
+    tf = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out_d = jax.block_until_ready(rd(q2, k2, v2))
+    td = time.perf_counter() - t0
+    err = float(jnp.max(jnp.abs(out_f - out_d)))
+    print(f"S=16384 fwd: ring-flash {tf:.1f}s vs ring-dense {td:.1f}s "
+          f"(incl. compile); max abs err {err:.2e}")
+    assert err < 5e-5, err
+    print("GREEN: ring-flash @S=16384 (bench length) matches the exact "
+          "ring schedule")
+
 
 if __name__ == "__main__":
     with capture() as buf:

@@ -14,3 +14,15 @@ EXAMPLES = sorted(
 @pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.name)
 def test_example_compiles(path):
     py_compile.compile(str(path), doraise=True)
+
+
+EVIDENCE_RUNNERS = sorted(
+    (pathlib.Path(__file__).resolve().parent.parent
+     / "tools" / "evidence").glob("*.py"))
+
+
+@pytest.mark.parametrize("path", EVIDENCE_RUNNERS, ids=lambda p: p.name)
+def test_evidence_runner_compiles(path):
+    """The committed EVIDENCE/ logs must stay regenerable: a runner that
+    stops byte-compiling is silent drift (full runs: `make evidence`)."""
+    py_compile.compile(str(path), doraise=True)

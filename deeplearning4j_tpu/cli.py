@@ -119,7 +119,13 @@ def cmd_train(args) -> int:
     divisor = 1
     if args.runtime == "spmd":
         from deeplearning4j_tpu.parallel import DataParallelTrainer
-        runner = DataParallelTrainer(net)
+        sync_every = int(props.get("train.sync.every", args.sync_every))
+        if sync_every > 1:
+            # local-SGD / Hogwild-router analog: replicas step on their
+            # own shard and average every N steps instead of every step
+            print(f"spmd: local-SGD mode, averaging every {sync_every} "
+                  f"steps")
+        runner = DataParallelTrainer(net, sync_every=sync_every)
         divisor = runner.n_devices
     else:
         runner = net
@@ -536,6 +542,11 @@ def build_parser() -> argparse.ArgumentParser:
     p_train.add_argument("-accum", "--accum", type=int, default=1,
                          help="gradient-accumulation microbatches per "
                               "update (local runtime)")
+    p_train.add_argument("-sync-every", "--sync-every", type=int,
+                         default=1,
+                         help="spmd runtime: average replicas every N "
+                              "steps instead of every step (local-SGD / "
+                              "Hogwild-router analog; 1 = sync SGD)")
     p_train.set_defaults(fn=cmd_train)
 
     p_lm = sub.add_parser(

@@ -325,3 +325,18 @@ def test_lm_mesh_runtime_single_device(tmp_path, monkeypatch):
                "-seq", "16", "-d-model", "32", "-layers", "4",
                "-heads", "4", "-runtime", "pipeline"])
     assert rc == 0
+
+
+def test_train_spmd_sync_every(tmp_path, iris_svmlight, model_json,
+                               capsys):
+    """-sync-every N on the spmd runtime trains in local-SGD mode
+    (replica averaging every N steps) and still converges on Iris."""
+    rc = main(["train", "-input", str(iris_svmlight), "-model",
+               str(model_json), "-output", str(tmp_path / "m"),
+               "-epochs", "30", "-batch", "32", "-runtime", "spmd",
+               "-sync-every", "4"])
+    assert rc == 0
+    got = capsys.readouterr().out
+    assert "local-SGD mode, averaging every 4 steps" in got
+    acc = float(re.search(r"Accuracy:\s+([0-9.]+)", got).group(1))
+    assert acc >= 0.85, got

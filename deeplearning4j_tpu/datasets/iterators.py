@@ -164,24 +164,42 @@ class PrefetchDataSetIterator(DataSetIterator):
     def __iter__(self):
         q = self._queue_mod.Queue(maxsize=self.depth)
         errors = []
+        stop = self._threading.Event()
+        full_exc = self._queue_mod.Full
+
+        def put_until_stopped(item) -> bool:
+            # A plain q.put would block FOREVER if the consumer abandons
+            # the generator mid-epoch (exception in the training loop):
+            # poll the stop flag so the producer always exits.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except full_exc:
+                    continue
+            return False
 
         def producer():
             try:
                 for item in self.base:
-                    q.put(item)
+                    if not put_until_stopped(item):
+                        return
             except Exception as e:  # noqa: BLE001 — re-raise on consumer side
                 errors.append(e)
             finally:
-                q.put(self._DONE)
+                put_until_stopped(self._DONE)
 
         t = self._threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is self._DONE:
-                break
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    break
+                yield item
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
         if errors:
             raise errors[0]
 

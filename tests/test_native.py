@@ -132,3 +132,37 @@ class TestPrefetch:
 
         with pytest.raises(RuntimeError, match="boom"):
             list(PrefetchDataSetIterator(BoomIter()))
+
+
+class TestPrefetchAbandonment:
+    def test_abandoned_consumer_does_not_leak_blocked_producer(self):
+        """Breaking out of the loop mid-epoch (e.g. an exception in the
+        training step) must stop the producer thread rather than leave
+        it blocked forever on the full queue."""
+        import threading
+        import time
+
+        class Endless:
+            def __iter__(self):
+                i = 0
+                while True:
+                    yield i
+                    i += 1
+
+            def reset(self):
+                pass
+
+            def batch_size(self):
+                return 1
+
+            def total_examples(self):
+                return 0
+
+        before = threading.active_count()
+        it = iter(PrefetchDataSetIterator(Endless(), depth=1))
+        assert next(it) == 0
+        it.close()  # consumer abandons mid-epoch
+        deadline = time.time() + 6.0
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before, "producer thread leaked"

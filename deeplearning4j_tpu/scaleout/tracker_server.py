@@ -66,6 +66,11 @@ _SAFE_GLOBALS = {
     ("deeplearning4j_tpu.datasets.dataset", "DataSet"),
 }
 _TAG_LEN = hashlib.sha256().digest_size
+# Frames are buffered in full before the HMAC check, so the length prefix
+# must be capped or an unauthenticated peer could claim 4 GiB and exhaust
+# memory.  1 GiB default clears any real param tree / job batch; override
+# with DL4J_TRACKER_MAX_FRAME.
+_MAX_FRAME = int(os.environ.get("DL4J_TRACKER_MAX_FRAME", 1 << 30))
 
 
 class _RestrictedUnpickler(pickle.Unpickler):
@@ -93,6 +98,9 @@ def _send_frame(sock: socket.socket, obj: Any,
 def _recv_frame(sock: socket.socket, secret: Optional[bytes] = None) -> Any:
     header = _recv_exact(sock, 4)
     (length,) = struct.unpack(">I", header)
+    if length > _MAX_FRAME:
+        raise ConnectionError(
+            f"tracker frame length {length} exceeds cap {_MAX_FRAME}")
     data = _recv_exact(sock, length)
     if secret:
         if length < _TAG_LEN:

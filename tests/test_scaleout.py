@@ -410,3 +410,23 @@ class TestRepeatedSimulate:
         assert tracker.current_jobs() == []
         assert tracker.drain_updates() == []
         assert "w1" in tracker.workers()  # registrations survive
+
+
+def test_tracker_frame_length_cap(monkeypatch):
+    """A peer claiming an absurd frame length must be rejected before the
+    server buffers it (memory-exhaustion guard on the control plane)."""
+    import socket
+    import struct
+
+    from deeplearning4j_tpu.scaleout.tracker_server import StateTrackerServer
+
+    server = StateTrackerServer().start()
+    try:
+        host, port = server.address
+        with socket.create_connection((host, port), timeout=10) as s:
+            s.sendall(struct.pack(">I", (1 << 30) + 1))
+            s.settimeout(10)
+            # server drops the connection without reading the body
+            assert s.recv(1) == b""
+    finally:
+        server.stop()

@@ -39,7 +39,7 @@ def load_txt_vectors(path: os.PathLike) -> WordVectors:
             vocab.add(first[0])
             rows.append([float(v) for v in first[1:]])
         for line in f:
-            parts = line.rstrip("\n").split(" ")
+            parts = line.split()  # robust to repeated/trailing whitespace
             if len(parts) < 2:
                 continue
             vocab.add(parts[0])

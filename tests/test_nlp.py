@@ -363,3 +363,14 @@ class TestWord2VecSingleCorePath:
             return m.syn0
 
         np.testing.assert_array_equal(train(2), train(1))
+
+
+def test_load_txt_vectors_tolerates_ragged_whitespace(tmp_path):
+    """Files from other writers may carry double spaces or trailing
+    whitespace per line (gensim pads occasionally); the loader must not
+    crash on float('')."""
+    p = tmp_path / "v.txt"
+    p.write_text("apple 1.0  2.0 3.0 \nbanana 4.0 5.0 6.0\t\n")
+    wv = load_txt_vectors(p)
+    assert wv.get_word_vector("apple") is not None
+    np.testing.assert_allclose(wv.get_word_vector("banana"), [4, 5, 6])

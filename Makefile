@@ -1,10 +1,15 @@
 # Convenience entry points. Everything here is reproducible by hand —
 # the targets just spell the one-liners out.
 
-.PHONY: test dryrun bench smoke evidence
+.PHONY: test dryrun bench smoke evidence lint
 
 test:
 	python -m pytest tests/ -x -q
+
+# Broad-except linter (see docs/robustness.md): fails on new bare
+# `except Exception:` in deeplearning4j_tpu/ without a noqa pragma.
+lint:
+	python tools/lint_excepts.py
 
 # Multichip dryrun (8 virtual CPU devices) + committed evidence log in
 # EVIDENCE/. Safe under a wedged TPU tunnel (env decision precedes jax).

@@ -144,15 +144,24 @@ def cmd_train(args) -> int:
                     b = type(b)(b.features[idx], b.labels[idx])
                 yield b
 
+    out = pathlib.Path(args.output or "dl4j-output")
+    ckpt_dir = (pathlib.Path(args.ckpt_dir) if args.ckpt_dir
+                else out / "ckpts")
+    will_resume = False
+    if args.resilience:
+        from deeplearning4j_tpu.runtime.checkpoint import latest_checkpoint
+
+        will_resume = latest_checkpoint(ckpt_dir) is not None
     fresh_model = (args.model.startswith("zoo:")
                    or not pathlib.Path(args.model).is_dir())
-    if net.conf.pretrain and fresh_model:
+    if net.conf.pretrain and fresh_model and not will_resume:
         # Greedy layer-wise pretraining for DBN/deep-AE configs
         # (reference pretrain-then-finetune, MultiLayerNetwork.java:148)
         # — without this a `zoo:dbn-mnist` train would silently skip the
         # step the model family depends on.  Resuming from a SAVED model
-        # dir skips it: re-pretraining finetuned weights would damage
-        # them.
+        # dir skips it (re-pretraining finetuned weights would damage
+        # them), as does a resilience resume (sup.resume() would discard
+        # the pretraining result anyway by restoring checkpoint params).
         net.pretrain(list(ds.shuffle(seed=0).batch_by(batch)), epochs=1)
     t0 = time.time()
     # Prefetch shuffles/slices/pads batch b+1 on a host thread while the
@@ -162,20 +171,66 @@ def cmd_train(args) -> int:
     if accum > 1 and runner is not net:
         print("-accum is a local-runtime feature; ignored under spmd")
         accum = 1
-    last = None
-    for b in PrefetchDataSetIterator(_batches()):
-        if accum > 1 and runner is net:
-            last = runner.fit_batch_async(b.features, b.labels,
-                                          accum_steps=accum)
-        else:
-            last = runner.fit_batch_async(b.features, b.labels)
-    if last is not None:
-        import jax
+    if args.resilience:
+        # Supervised training: poison-batch skipping, divergence rollback,
+        # retrying fetches, preemption-safe checkpointing.  The health
+        # checks need the loss on the host, so steps do not pipeline —
+        # the documented cost of supervision (docs/robustness.md).
+        from deeplearning4j_tpu.resilience import (
+            ResilienceConfig,
+            TrainingSupervisor,
+        )
 
-        jax.block_until_ready(last)
+        if accum > 1:
+            print("-accum is ignored under -resilience")
+            accum = 1
+        sup = TrainingSupervisor(runner, ResilienceConfig(
+            checkpoint_dir=ckpt_dir,
+            checkpoint_every=args.ckpt_every,
+            keep=args.ckpt_keep,
+            skip_budget=args.skip_budget,
+            divergence_factor=args.divergence_factor,
+            step_timeout=args.step_timeout))
+        sup.install_signal_handlers()
+        stream = _batches()
+        if sup.resume():
+            print(f"resilience: resumed from checkpoint step {sup.step} "
+                  f"under {ckpt_dir}")
+            # Fast-forward the (deterministic, seed-per-epoch) schedule
+            # past every batch the preempted run CONSUMED (not just its
+            # update count — skipped poison batches consume a batch with
+            # no step) so the resumed run trains the TAIL of the plan
+            # instead of re-training its head.
+            import itertools
+
+            stream = itertools.islice(stream, sup.batches_consumed, None)
+        # Bound the run by the PLANNED update budget (epochs x batches per
+        # epoch): a resumed run completes the remaining steps instead of
+        # replaying the whole schedule on top of the checkpoint.
+        import math
+
+        total_steps = epochs * math.ceil(ds.num_examples() / batch)
+        report = sup.run(stream, max_steps=total_steps)
+        print(f"resilience: {report.summary()}")
+        for fault in report.faults:
+            print(f"resilience:   {fault}")
+        if report.preempted:
+            print(f"resilience: preempted — emergency checkpoint at step "
+                  f"{report.steps}; re-run the same command to resume")
+    else:
+        last = None
+        for b in PrefetchDataSetIterator(_batches()):
+            if accum > 1 and runner is net:
+                last = runner.fit_batch_async(b.features, b.labels,
+                                              accum_steps=accum)
+            else:
+                last = runner.fit_batch_async(b.features, b.labels)
+        if last is not None:
+            import jax
+
+            jax.block_until_ready(last)
     elapsed = time.time() - t0
 
-    out = pathlib.Path(args.output or "dl4j-output")
     out.mkdir(parents=True, exist_ok=True)
     save_model(net, out / "model")
     save_params(net, out / ("params.bin" if args.savemode == "binary"
@@ -547,6 +602,37 @@ def build_parser() -> argparse.ArgumentParser:
                          help="spmd runtime: average replicas every N "
                               "steps instead of every step (local-SGD / "
                               "Hogwild-router analog; 1 = sync SGD)")
+    p_train.add_argument("-resilience", "--resilience",
+                         action="store_true",
+                         help="supervise training: skip poison batches, "
+                              "roll back on divergence with LR backoff, "
+                              "retry fetches, checkpoint periodically, "
+                              "and flush an emergency checkpoint on "
+                              "SIGTERM (resume by re-running)")
+    p_train.add_argument("-ckpt-dir", "--ckpt-dir", dest="ckpt_dir",
+                         default=None,
+                         help="resilience checkpoint directory "
+                              "(default <output>/ckpts)")
+    p_train.add_argument("-ckpt-every", "--ckpt-every", dest="ckpt_every",
+                         type=int, default=50,
+                         help="steps between periodic checkpoints")
+    p_train.add_argument("-ckpt-keep", "--ckpt-keep", dest="ckpt_keep",
+                         type=int, default=3,
+                         help="keep the newest K checkpoints (the best-"
+                              "scoring one is always retained)")
+    p_train.add_argument("-skip-budget", "--skip-budget",
+                         dest="skip_budget", type=int, default=5,
+                         help="max poison (non-finite) batches skipped "
+                              "before aborting")
+    p_train.add_argument("-divergence-factor", "--divergence-factor",
+                         dest="divergence_factor", type=float,
+                         default=10.0,
+                         help="roll back when loss exceeds this multiple "
+                              "of the rolling median")
+    p_train.add_argument("-step-timeout", "--step-timeout",
+                         dest="step_timeout", type=float, default=None,
+                         help="watchdog: fail a training step exceeding "
+                              "this many seconds (default: no watchdog)")
     p_train.set_defaults(fn=cmd_train)
 
     p_lm = sub.add_parser(

@@ -16,13 +16,40 @@ from __future__ import annotations
 
 import gzip
 import hashlib
+import http.client
 import os
 import shutil
+import tarfile
 import urllib.error
 import urllib.request
 import warnings
+import zipfile
+import zlib
 from pathlib import Path
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
+
+from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
+
+# The download failures worth refetching: OSError (URLError/HTTPError/
+# BadGzipFile are subclasses), http.client.HTTPException (IncompleteRead/
+# BadStatusLine escape as-is once the response body is streaming — NOT
+# OSError), EOFError (truncated gzip stream), zlib.error (corrupt deflate
+# data), and ValueError (SHA-256 mismatch / structural check = corrupt
+# body).  Anything else is a real bug and propagates.
+TRANSIENT_DOWNLOAD_ERRORS = (OSError, http.client.HTTPException, EOFError,
+                             zlib.error, ValueError)
+
+# Shared download retry: per-mirror exponential backoff with jitter.
+DOWNLOAD_RETRY = RetryPolicy(max_attempts=3, base_delay=0.5, max_delay=8.0,
+                             retryable=TRANSIENT_DOWNLOAD_ERRORS)
+
+
+def _warn_retry(url: str):
+    def on_retry(attempt: int, e: Exception, delay: float) -> None:
+        warnings.warn(
+            f"download attempt {attempt} of {url} failed ({e}); "
+            f"retrying in {delay:.1f}s", stacklevel=4)
+    return on_retry
 
 
 def cache_dir(name: str) -> Path:
@@ -103,24 +130,40 @@ def fetch_mnist(dest: Optional[Path] = None) -> Path:
             try:
                 _check_gzip(path)
                 continue
-            except OSError:
+            except (OSError, EOFError, zlib.error):
                 path.unlink()  # corrupt cache entry from an earlier run
         for base in bases:
+            url = base.rstrip("/") + "/" + fname
             try:
-                download(base.rstrip("/") + "/" + fname, path)
-                _check_gzip(path)
+                # exponential backoff + jitter per mirror, then fall
+                # through to the next mirror
+                retry_call(
+                    lambda u=url: _download_checked(u, path,
+                                                    check=_check_gzip),
+                    policy=DOWNLOAD_RETRY, on_retry=_warn_retry(url))
                 break
-            except Exception as e:  # noqa: BLE001 — try next mirror
+            except TRANSIENT_DOWNLOAD_ERRORS as e:
                 last_err = e
-                # A corrupt body (captive portal, error page) must not
-                # poison the cache: the retry and every later call would
-                # reuse it as-is.
-                if path.exists():
-                    path.unlink()
         else:
             raise RuntimeError(
                 f"could not download {fname} from any mirror: {last_err}")
     return dest
+
+
+def _download_checked(url: str, path: Path,
+                      check: Optional[Callable[[Path], None]] = None,
+                      sha256: Optional[str] = None) -> None:
+    """One download attempt + optional post-check; a corrupt body
+    (captive portal, error page, truncated stream) must not poison the
+    cache, so the file is unlinked before the failure propagates to the
+    retry loop."""
+    try:
+        download(url, path, sha256=sha256)
+        if check is not None:
+            check(path)
+    except TRANSIENT_DOWNLOAD_ERRORS:
+        path.unlink(missing_ok=True)
+        raise
 
 
 def _check_gzip(path: Path) -> None:
@@ -136,9 +179,6 @@ CIFAR10_SHA256 = ("6d958be074577803d12ecdefd02955f3"
 def fetch_cifar10(dest: Optional[Path] = None) -> Path:
     """Download-and-cache CIFAR-10 (python pickle batches); returns the
     extracted `cifar-10-batches-py` directory. Raises when offline."""
-    import shutil
-    import tarfile
-
     root = Path(dest) if dest else cache_dir("cifar10")
     extracted = root / "cifar-10-batches-py"
     if extracted.is_dir():
@@ -154,7 +194,8 @@ def fetch_cifar10(dest: Optional[Path] = None) -> Path:
         warnings.warn(
             f"CIFAR10_URL override ({url}): sha256 verification DISABLED "
             "for this download", stacklevel=2)
-    download(url, archive, sha256=sha)
+    retry_call(lambda: _download_checked(url, archive, sha256=sha),
+               policy=DOWNLOAD_RETRY, on_retry=_warn_retry(url))
     tmp = root / ".extract.tmp"
     if tmp.exists():
         shutil.rmtree(tmp)
@@ -162,9 +203,11 @@ def fetch_cifar10(dest: Optional[Path] = None) -> Path:
         with tarfile.open(archive) as tf:
             tf.extractall(tmp, filter="data")
         (tmp / "cifar-10-batches-py").rename(extracted)
-    except Exception:
+    except (OSError, tarfile.TarError):
         # A corrupt body (captive portal, error page — possible whenever
-        # CIFAR10_URL bypasses the sha256 pin) must not poison the cache.
+        # CIFAR10_URL bypasses the sha256 pin) must not poison the cache;
+        # anything else (KeyboardInterrupt, real bugs) propagates with
+        # the archive intact.
         archive.unlink(missing_ok=True)
         raise
     finally:
@@ -190,8 +233,6 @@ def fetch_text8(dest: Optional[Path] = None) -> Path:
     raises when offline.  No published SHA-256 exists for the canonical
     host, so the body is validated structurally instead (exact 1e8-byte
     length, a-z/space alphabet)."""
-    import zipfile
-
     override = os.environ.get("TEXT8_PATH")
     if override:
         p = Path(override)
@@ -208,11 +249,11 @@ def fetch_text8(dest: Optional[Path] = None) -> Path:
     last_err: Exception = RuntimeError("no text8 URL configured")
     for url in TEXT8_URLS:
         try:
-            download(url, archive)
+            retry_call(lambda u=url: _download_checked(u, archive),
+                       policy=DOWNLOAD_RETRY, on_retry=_warn_retry(url))
             break
-        except Exception as e:  # noqa: BLE001 - try the mirror
+        except TRANSIENT_DOWNLOAD_ERRORS as e:
             last_err = e
-            archive.unlink(missing_ok=True)
     else:
         raise RuntimeError(f"text8 unreachable: {last_err}")
     try:
@@ -222,7 +263,7 @@ def fetch_text8(dest: Optional[Path] = None) -> Path:
             if not head or not set(head) <= set(b"abcdefghijklmnopqrstuvwxyz "):
                 raise ValueError("text8 body failed structural check")
             zf.extract("text8", root)
-    except Exception:
+    except (OSError, EOFError, zlib.error, ValueError, zipfile.BadZipFile):
         archive.unlink(missing_ok=True)
         extracted.unlink(missing_ok=True)
         raise

@@ -113,7 +113,7 @@ def mnist_dataset(split: str = "train", binarize: bool = False,
             "sklearn 8x8 digits upscaled to 28x28")
     try:
         return _digits_as_mnist(split, binarize, flatten)
-    except Exception:
+    except Exception:  # noqa: BLE001 — any failure -> synthetic fallback
         downloader.warn_fallback("mnist_dataset", "sklearn digits unavailable",
                                  "synthetic Gaussian blobs")
         return synthetic_mnist(6000 if split == "train" else 1000,

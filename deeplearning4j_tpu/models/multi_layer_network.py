@@ -42,7 +42,11 @@ from deeplearning4j_tpu.nn.layers.pretrain import (
     rbm_cd_grads,
 )
 from deeplearning4j_tpu.ops import losses as losses_mod
-from deeplearning4j_tpu.ops.updaters import apply_updates, make_updater
+from deeplearning4j_tpu.ops.updaters import (
+    apply_updates,
+    global_grad_norm,
+    make_updater,
+)
 
 PyTree = Any
 
@@ -115,6 +119,12 @@ class MultiLayerNetwork:
                 "desynchronizes the accumulated-update state")
         self._updater = make_updater(conf.conf.updater_config())
         self._dtype = jnp.dtype(conf.conf.dtype)
+        # Supervisor hook points (resilience/): a traced update scale the
+        # TrainingSupervisor backs off on rollback without recompiling,
+        # and the last step's global gradient norm (device array, synced
+        # only when a health check reads it).
+        self._lr_scale = 1.0
+        self.last_grad_norm: Optional[jax.Array] = None
         self._listeners: list = []
         self._jit_train_step = None
         self._jit_forward = None
@@ -266,8 +276,10 @@ class MultiLayerNetwork:
 
         # donate the carried training state: params/opt-state buffers are
         # re-used in place instead of copied every step (HBM hygiene).
+        # lr_scale is a TRACED scalar: the supervisor's rollback backoff
+        # changes it between steps without triggering a recompile.
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def train_step(params, state, upd_state, x, y, rng, mask):
+        def train_step(params, state, upd_state, x, y, rng, mask, lr_scale):
             if accum == 1:
                 def lossfn(p):
                     return self._objective(p, state, x, y, rng, mask)
@@ -321,10 +333,14 @@ class MultiLayerNetwork:
                 grads = jax.tree_util.tree_map(
                     lambda g: g / w_total, grads)
                 loss = loss / w_total
+            # Health-monitor signal: global grad norm, one extra reduction
+            # fused into the step (negligible next to the backward).
+            gnorm = global_grad_norm(grads)
             updates, upd_state = updater.update(grads, upd_state, params)
             updates = self._apply_lr_multipliers(updates)
+            updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
             params = apply_updates(params, updates)
-            return params, new_state, upd_state, loss
+            return params, new_state, upd_state, loss, gnorm
 
         return train_step
 
@@ -364,8 +380,11 @@ class MultiLayerNetwork:
         x = jnp.asarray(x)
         y = jnp.asarray(y)
         mask = None if mask is None else jnp.asarray(mask)
-        self.params, self.state, self.updater_state, loss = step(
-            self.params, self.state, self.updater_state, x, y, rng, mask)
+        lr_scale = jnp.asarray(self._lr_scale, jnp.float32)
+        (self.params, self.state, self.updater_state, loss,
+         self.last_grad_norm) = step(
+            self.params, self.state, self.updater_state, x, y, rng, mask,
+            lr_scale)
         self._iteration += 1
         if self._listeners:
             loss_f = float(loss)
@@ -377,6 +396,46 @@ class MultiLayerNetwork:
         """One SGD step on one minibatch (reference fit(INDArray,INDArray)
         :1244). Returns the loss."""
         return float(self.fit_batch_async(x, y, mask, accum_steps))
+
+    # ---- resilience hook points -------------------------------------------
+
+    def set_lr_scale(self, scale: float) -> None:
+        """Scale every applied update by `scale` from the next step on —
+        the TrainingSupervisor's rollback backoff.  Traced into the jitted
+        step, so changing it never recompiles.  Exactly equivalent to
+        scaling the learning rate for every updater whose step is linear
+        in lr; AdaDelta (no lr term) gets a one-time warning because
+        scaling its applied step desynchronizes its accumulated-update
+        statistics."""
+        scale = float(scale)
+        if scale <= 0.0:
+            raise ValueError(f"lr_scale must be > 0, got {scale}")
+        if (scale != 1.0 and self.conf.conf.updater == "adadelta"
+                and self._lr_scale == 1.0):
+            warnings.warn(
+                "lr_scale with AdaDelta is approximate: its update has no "
+                "learning-rate term, so scaling the applied step "
+                "desynchronizes the accumulated-update state", stacklevel=2)
+        self._lr_scale = scale
+
+    def restore_train_state(self, step: int, params: PyTree,
+                            updater_state: Optional[PyTree] = None,
+                            net_state: Optional[PyTree] = None) -> None:
+        """Adopt checkpointed training state (params [+ updater moments
+        and layer state]) and rewind the iteration counter, so the
+        per-step RNG fold-in and listener schedules replay exactly as an
+        uninterrupted run — the supervisor's rollback/resume entry point.
+        `net_state` matters for layers with running statistics (batch
+        norm): an exploding step poisons them before the loss reaches the
+        host, so rolling back params alone would keep the poison."""
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        if updater_state is not None:
+            self.updater_state = jax.tree_util.tree_map(
+                jnp.asarray, updater_state)
+        if net_state is not None:
+            self.state = jax.tree_util.tree_map(jnp.asarray, net_state)
+        self._iteration = int(step)
+        self._updater_state_owner = None
 
     def fit(self, data, epochs: int = 1, accum_steps: int = 1
             ) -> "MultiLayerNetwork":

@@ -76,6 +76,15 @@ def _global_norm(tree: PyTree) -> jax.Array:
     return jnp.sqrt(sum(jnp.sum(jnp.square(l)) for l in leaves))
 
 
+def global_grad_norm(tree: PyTree) -> jax.Array:
+    """Global L2 norm with float32 accumulation — the health-monitor
+    signal every train step surfaces (resilience subsystem); f32 so a
+    bf16 gradient tree can't overflow the sum of squares early."""
+    return jnp.sqrt(sum(
+        jnp.sum(jnp.square(jnp.asarray(l).astype(jnp.float32)))
+        for l in jax.tree_util.tree_leaves(tree)))
+
+
 def pre_apply(grads: PyTree, params: PyTree, cfg: UpdaterConfig) -> PyTree:
     """Fold L1/L2 penalties and clipping into the raw gradient — the TPU-native
     equivalent of reference BaseUpdater.postApply():44-58 (which mutated the

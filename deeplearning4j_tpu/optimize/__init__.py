@@ -16,6 +16,7 @@ Hessian-free solver gets curvature products from `jax.jvp(jax.grad(f))`.
 
 from deeplearning4j_tpu.optimize.api import (
     OptimizationAlgorithm,
+    InvalidScoreError,
     IterationListener,
     ComposableIterationListener,
     NanGuardListener,
@@ -38,6 +39,7 @@ from deeplearning4j_tpu.optimize.terminations import (
 
 __all__ = [
     "OptimizationAlgorithm",
+    "InvalidScoreError",
     "IterationListener",
     "ComposableIterationListener",
     "NanGuardListener",

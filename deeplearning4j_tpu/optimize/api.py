@@ -48,6 +48,23 @@ class ScoreIterationListener(IterationListener):
             self._out(f"Score at iteration {iteration} is {score}")
 
 
+class InvalidScoreError(FloatingPointError):
+    """Typed non-finite-score failure carrying the step and score, so a
+    supervisor (resilience.TrainingSupervisor) can catch it precisely and
+    roll back instead of pattern-matching message strings.  Subclasses
+    FloatingPointError so pre-existing handlers keep working."""
+
+    def __init__(self, step: int, score: float, detail: str = ""):
+        msg = (f"training score became {score} at iteration {step} "
+               f"— exploding/NaN loss; lower the learning rate, clip "
+               f"gradients, or inspect the input batch")
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+        self.step = int(step)
+        self.score = float(score)
+
+
 class NanGuardListener(IterationListener):
     """Fails LOUDLY the moment the training score goes non-finite,
     instead of silently training on garbage — the reference's defensive
@@ -58,10 +75,7 @@ class NanGuardListener(IterationListener):
 
     def iteration_done(self, model, iteration: int, score: float) -> None:
         if not math.isfinite(score):
-            raise FloatingPointError(
-                f"training score became {score} at iteration {iteration} "
-                f"— exploding/NaN loss; lower the learning rate, clip "
-                f"gradients, or inspect the input batch")
+            raise InvalidScoreError(iteration, score)
 
 
 class ComposableIterationListener(IterationListener):

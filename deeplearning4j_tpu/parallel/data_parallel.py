@@ -48,7 +48,11 @@ from deeplearning4j_tpu.models.multi_layer_network import (
     _as_batches,
     _maybe_reset,
 )
-from deeplearning4j_tpu.ops.updaters import apply_updates, make_updater
+from deeplearning4j_tpu.ops.updaters import (
+    apply_updates,
+    global_grad_norm,
+    make_updater,
+)
 from deeplearning4j_tpu.parallel import mesh as mesh_lib
 
 
@@ -101,7 +105,7 @@ class DataParallelTrainer:
         updater = self._updater
         axis = self.axis
 
-        def shard_step(params, state, upd_state, x, y, rng, mask):
+        def shard_step(params, state, upd_state, x, y, rng, mask, lr_scale):
             # Different dropout/sampling per shard, same init everywhere.
             rng = jax.random.fold_in(rng, lax.axis_index(axis))
 
@@ -115,14 +119,17 @@ class DataParallelTrainer:
             # IterativeReduce, and the YARN master (SURVEY §3.2).
             grads = lax.pmean(grads, axis)
             loss = lax.pmean(loss, axis)
+            gnorm = global_grad_norm(grads)
             new_state = jax.tree_util.tree_map(
                 lambda s: lax.pmean(s, axis) if jnp.issubdtype(
                     jnp.asarray(s).dtype, jnp.floating) else s,
                 new_state)
             updates, upd_state = updater.update(grads, upd_state, params)
             updates = net._apply_lr_multipliers(updates)
+            updates = jax.tree_util.tree_map(lambda u: u * lr_scale,
+                                             updates)
             params = apply_updates(params, updates)
-            return params, new_state, upd_state, loss
+            return params, new_state, upd_state, loss, gnorm
 
         pspec = P()          # replicated params/state
         dspec = P(self.axis)  # batch-sharded data
@@ -130,8 +137,9 @@ class DataParallelTrainer:
         fn = shard_map(
             shard_step,
             mesh=self.mesh,
-            in_specs=(pspec, pspec, pspec, dspec, dspec, pspec, dspec),
-            out_specs=(pspec, pspec, pspec, pspec),
+            in_specs=(pspec, pspec, pspec, dspec, dspec, pspec, dspec,
+                      pspec),
+            out_specs=(pspec, pspec, pspec, pspec, pspec),
             check_rep=False,
         )
         return jax.jit(fn)
@@ -159,7 +167,7 @@ class DataParallelTrainer:
         k0, unravel = self._flat_meta()
         k = self._flat_k = ((k0 + n - 1) // n) * n  # padded flat length
 
-        def shard_step(params, state, upd_shard, x, y, rng, mask):
+        def shard_step(params, state, upd_shard, x, y, rng, mask, lr_scale):
             rng = jax.random.fold_in(rng, lax.axis_index(axis))
 
             def lossfn(p):
@@ -171,11 +179,16 @@ class DataParallelTrainer:
             flat_g = jnp.pad(flat_g, (0, k - k0))
             # mean-gradient SHARD: [k/n] per replica, not the full [k]
             g_shard = lax.psum_scatter(flat_g, axis, tiled=True) / n
+            # global mean-grad norm from the shards (padding is zero)
+            gnorm = jnp.sqrt(lax.psum(
+                jnp.sum(jnp.square(g_shard.astype(jnp.float32))), axis))
             flat_p = jnp.pad(ravel_pytree(params)[0], (0, k - k0))
             p_shard = lax.dynamic_slice_in_dim(
                 flat_p, lax.axis_index(axis) * (k // n), k // n)
             updates, upd_shard = updater.update(
                 {"p": g_shard}, upd_shard, {"p": p_shard})
+            updates = jax.tree_util.tree_map(lambda u: u * lr_scale,
+                                             updates)
             new_shard = apply_updates({"p": p_shard}, updates)["p"]
             new_flat = lax.all_gather(new_shard, axis, tiled=True)[:k0]
             params = unravel(new_flat)
@@ -184,7 +197,7 @@ class DataParallelTrainer:
                 lambda s: lax.pmean(s, axis) if jnp.issubdtype(
                     jnp.asarray(s).dtype, jnp.floating) else s,
                 new_state)
-            return params, new_state, upd_shard, loss
+            return params, new_state, upd_shard, loss, gnorm
 
         pspec = P()
         dspec = P(self.axis)
@@ -197,8 +210,9 @@ class DataParallelTrainer:
         fn = shard_map(
             shard_step,
             mesh=self.mesh,
-            in_specs=(pspec, pspec, sspec, dspec, dspec, pspec, dspec),
-            out_specs=(pspec, pspec, sspec, pspec),
+            in_specs=(pspec, pspec, sspec, dspec, dspec, pspec, dspec,
+                      pspec),
+            out_specs=(pspec, pspec, sspec, pspec, pspec),
             check_rep=False,
         )
         return jax.jit(fn)
@@ -281,7 +295,8 @@ class DataParallelTrainer:
         updater = self._updater
         axis = self.axis
 
-        def local_step(rep_params, rep_state, rep_upd, x, y, rng, mask):
+        def local_step(rep_params, rep_state, rep_upd, x, y, rng, mask,
+                       lr_scale):
             # Each shard sees leaves of shape [1, ...]: this replica's slot.
             params = jax.tree_util.tree_map(lambda a: a[0], rep_params)
             state = jax.tree_util.tree_map(lambda a: a[0], rep_state)
@@ -293,8 +308,13 @@ class DataParallelTrainer:
 
             (loss, new_state), grads = jax.value_and_grad(
                 lossfn, has_aux=True)(params)
+            # mean of per-replica local grad norms (no global gradient
+            # exists between syncs in local-SGD mode)
+            gnorm = lax.pmean(global_grad_norm(grads), axis)
             updates, upd_state = updater.update(grads, upd_state, params)
             updates = net._apply_lr_multipliers(updates)
+            updates = jax.tree_util.tree_map(lambda u: u * lr_scale,
+                                             updates)
             params = apply_updates(params, updates)
             loss = lax.pmean(loss, axis)
 
@@ -303,15 +323,15 @@ class DataParallelTrainer:
                     lambda a: jnp.asarray(a)[None], t)
 
             return (restack(params), restack(new_state), restack(upd_state),
-                    loss)
+                    loss, gnorm)
 
         rspec = P(self.axis)  # per-replica stacked state
         dspec = P(self.axis)
         fn = shard_map(
             local_step,
             mesh=self.mesh,
-            in_specs=(rspec, rspec, rspec, dspec, dspec, P(), dspec),
-            out_specs=(rspec, rspec, rspec, P()),
+            in_specs=(rspec, rspec, rspec, dspec, dspec, P(), dspec, P()),
+            out_specs=(rspec, rspec, rspec, P(), P()),
             check_rep=False,
         )
         return jax.jit(fn)
@@ -348,9 +368,12 @@ class DataParallelTrainer:
         ys = mesh_lib.shard_batch(self.mesh, jnp.asarray(y), self.axis)
         ms = (None if mask is None
               else mesh_lib.shard_batch(self.mesh, jnp.asarray(mask), self.axis))
+        scale = jnp.asarray(net._lr_scale, jnp.float32)
         if self.shard_update:
-            net.params, net.state, self._opt_shard, loss = self._step_fn(
-                net.params, net.state, self._opt_shard, xs, ys, rng, ms)
+            (net.params, net.state, self._opt_shard, loss,
+             net.last_grad_norm) = self._step_fn(
+                net.params, net.state, self._opt_shard, xs, ys, rng, ms,
+                scale)
             # The TRAINER owns the (sharded) optimizer state while this
             # mode runs: the net's copy is cleared (so direct
             # net.fit_batch restarts with fresh moments instead of a
@@ -363,14 +386,17 @@ class DataParallelTrainer:
             net.updater_state = None
             net._updater_state_owner = self
         elif self.sync_every == 1:
-            net.params, net.state, net.updater_state, loss = self._step_fn(
-                net.params, net.state, net.updater_state, xs, ys, rng, ms)
+            (net.params, net.state, net.updater_state, loss,
+             net.last_grad_norm) = self._step_fn(
+                net.params, net.state, net.updater_state, xs, ys, rng, ms,
+                scale)
         else:
             if self._rep is None:
                 self._rep = tuple(self._stack(t) for t in
                                   (net.params, net.state, net.updater_state))
             p, s, u = self._rep
-            p, s, u, loss = self._step_fn(p, s, u, xs, ys, rng, ms)
+            p, s, u, loss, net.last_grad_norm = self._step_fn(
+                p, s, u, xs, ys, rng, ms, scale)
             self._rep = (p, s, u)
         self._iteration += 1
         if self.sync_every > 1 and self._iteration % self.sync_every == 0:
@@ -393,13 +419,10 @@ class DataParallelTrainer:
         self.finalize()  # publish trainer-held state back to the net
         return self
 
-    def _average_params(self) -> None:
-        """Every-N parameter averaging for the local-SGD/Hogwild-parity mode
-        (the reference's HogWildWorkRouter semantics): pmean over the replica
-        axis of the stacked per-replica state; float updater/layer state is
-        averaged too, so the replicas restart the next round identical."""
-        if self._rep is None:
-            return
+    def _averaged_rep(self):
+        """pmean over the replica axis of the stacked per-replica state
+        (float updater/layer state averaged too); pure — does not touch
+        self._rep."""
         if self._avg_fn is None:
             axis = self.axis
 
@@ -412,13 +435,66 @@ class DataParallelTrainer:
                 lambda p, s, u: (avg_tree(p), avg_tree(s), avg_tree(u)),
                 mesh=self.mesh, in_specs=(P(self.axis),) * 3,
                 out_specs=(P(self.axis),) * 3, check_rep=False))
-        self._rep = self._avg_fn(*self._rep)
-        # Publish the averaged copy (replica 0's slot — all equal now).
-        p, s, u = self._rep
+        return self._avg_fn(*self._rep)
+
+    def _publish_rep(self, rep) -> None:
+        """Write one replica-averaged copy to the net (replica 0's slot —
+        all equal after _averaged_rep)."""
+        p, s, u = rep
         unstack = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)  # noqa: E731
         self.net.params = unstack(p)
         self.net.state = unstack(s)
         self.net.updater_state = unstack(u)
+
+    def _average_params(self) -> None:
+        """Every-N parameter averaging for the local-SGD/Hogwild-parity mode
+        (the reference's HogWildWorkRouter semantics): the replicas restart
+        the next round identical."""
+        if self._rep is None:
+            return
+        self._rep = self._averaged_rep()
+        self._publish_rep(self._rep)
+
+    def publish_train_state(self) -> None:
+        """Publish a CHECKPOINTABLE snapshot to net.params/state/
+        updater_state without perturbing training: local-SGD mode writes
+        the replica average to the net but leaves the per-replica `_rep`
+        untouched (no extra sync point is injected into the schedule);
+        shard_update publishes the sharded moments.  The resilience
+        supervisor calls this before every checkpoint so mid-sync-window
+        checkpoints carry current (not last-sync) parameters."""
+        if self.sync_every > 1 and self._rep is not None:
+            self._publish_rep(self._averaged_rep())
+        self.sync_updater_state_to_net()
+
+    def set_lr_scale(self, scale: float) -> None:
+        """Rollback-backoff hook (see MultiLayerNetwork.set_lr_scale);
+        the trainer reads the net's scale each step, so both paths stay
+        in sync."""
+        self.net.set_lr_scale(scale)
+
+    def restore_train_state(self, step: int, params, updater_state=None,
+                            net_state=None) -> None:
+        """Adopt checkpointed training state into BOTH the net and the
+        trainer's mode-specific carriers — the supervisor's
+        rollback/resume entry point.
+
+        - plain sync DP: the net's replicated state IS the training state;
+        - local-SGD (sync_every > 1): the stacked per-replica copy is
+          dropped and re-stacked from the restored net state at the next
+          step (per-replica drift since the checkpoint is not a thing
+          worth preserving across a rollback);
+        - shard_update: the sharded optimizer state is rebuilt from the
+          restored per-layer moments (device-count independent), and the
+          trainer re-registers as its owner."""
+        net = self.net
+        net.restore_train_state(step, params, updater_state, net_state)
+        self._iteration = int(step)
+        self._rep = None
+        if self.shard_update:
+            self._opt_shard = self._init_sharded_opt_state()
+            net.updater_state = None
+            net._updater_state_owner = self
 
     def finalize(self) -> None:
         """Publish trainer-held state back to the net: averages any

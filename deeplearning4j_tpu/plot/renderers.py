@@ -61,7 +61,7 @@ class FilterRenderer:
             ax.axis("off")
             fig.savefig(path, bbox_inches="tight", dpi=120)
             plt.close(fig)
-        except Exception:
+        except Exception:  # noqa: BLE001 — headless/no-mpl -> .npy dump
             np.save(os.path.splitext(path)[0] + ".npy", grid)
         return grid
 
@@ -106,7 +106,7 @@ class NeuralNetPlotter:
             import matplotlib
             matplotlib.use("Agg")
             import matplotlib.pyplot as plt
-        except Exception:
+        except Exception:  # noqa: BLE001 — no matplotlib -> skip plots
             return False
         n = max(len(flat), 1)
         cols = min(n, 3)

@@ -1,0 +1,69 @@
+"""Resilience: the training supervisor and its fault-tolerance substrate.
+
+The reference guarded every score with `LinAlgExceptions.assertValidNum`
+(`MultiLayerNetwork.java:677`) and simply threw — one NaN batch or one
+preempted worker killed the whole run.  Production TPU training needs runs
+that *survive* bad batches, flaky storage, and preemption; this package is
+the layer that decides when to checkpoint, when to roll back, and how to
+keep going:
+
+- `retry` — shared exponential-backoff-with-jitter policy (used by the
+  dataset downloaders and the supervisor's batch-fetch path).
+- `health` — per-step loss/grad-norm finiteness and divergence monitor
+  (loss > K x rolling median) that recommends skip/rollback actions.
+- `watchdog` — times out hung device steps and surfaces a structured
+  `FaultReport` instead of wedging the job.
+- `supervisor` — `TrainingSupervisor`: wraps any step runner
+  (`MultiLayerNetwork`, `DataParallelTrainer`) with poison-batch skipping,
+  divergence rollback to the last good checkpoint with LR backoff, a
+  checkpoint policy (every-N + keep-last-K + best-score retention), and
+  SIGTERM/preemption handling that flushes an emergency checkpoint.
+- `chaos` — deterministic fault injection (NaN batches, failing/slow
+  fetches, simulated preemption, hung steps) so every recovery path is
+  testable in CI on CPU.
+"""
+
+from deeplearning4j_tpu.resilience.chaos import (
+    ChaosConfig,
+    ChaosDataSource,
+    chaos_runner,
+)
+from deeplearning4j_tpu.resilience.faults import (
+    FaultReport,
+    PreemptedError,
+    SimulatedPreemption,
+    StepTimeoutError,
+    SupervisorAbort,
+)
+from deeplearning4j_tpu.resilience.health import HealthAction, HealthMonitor
+from deeplearning4j_tpu.resilience.retry import (
+    RetryPolicy,
+    backoff_delays,
+    retry_call,
+)
+from deeplearning4j_tpu.resilience.supervisor import (
+    ResilienceConfig,
+    RunReport,
+    TrainingSupervisor,
+)
+from deeplearning4j_tpu.resilience.watchdog import StepWatchdog
+
+__all__ = [
+    "ChaosConfig",
+    "ChaosDataSource",
+    "chaos_runner",
+    "FaultReport",
+    "PreemptedError",
+    "SimulatedPreemption",
+    "StepTimeoutError",
+    "SupervisorAbort",
+    "HealthAction",
+    "HealthMonitor",
+    "RetryPolicy",
+    "backoff_delays",
+    "retry_call",
+    "ResilienceConfig",
+    "RunReport",
+    "TrainingSupervisor",
+    "StepWatchdog",
+]

@@ -1,0 +1,116 @@
+"""Deterministic fault injection for the resilience subsystem.
+
+Every recovery path in the supervisor must be exercisable in CI on CPU
+without real network flakes or real preemptions, so faults are injected
+at *configured batch indices* — no randomness, same failures every run:
+
+- NaN batches: the batch at a configured index has its features replaced
+  with NaN (the poison-batch path).
+- Fetch failures: ``__next__`` raises OSError ONCE at a configured index;
+  the supervisor's retry gets the real batch on the next attempt (the
+  flaky-storage path).
+- Slow fetches: a configured delay before yielding (exercises prefetch /
+  watchdog margins).
+- Simulated preemption: raises `SimulatedPreemption` once when a
+  configured index is reached — the supervisor handles it like SIGTERM.
+- Hung steps: `chaos_runner` wraps a runner so ``fit_batch`` sleeps past
+  the watchdog timeout at configured supervisor steps.
+
+`ChaosDataSource` is a plain iterator (NOT a generator): raising from
+``__next__`` does not kill it, so the supervisor's retry/resume paths can
+keep pulling from the same source — including re-entering it after a
+preemption-restart with its position intact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.resilience.faults import SimulatedPreemption
+from deeplearning4j_tpu.resilience.supervisor import _normalize
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Batch indices (0-based, in fetch order) at which to inject faults."""
+
+    nan_steps: Sequence[int] = ()
+    fetch_fail_steps: Sequence[int] = ()
+    slow_fetch_steps: Sequence[int] = ()
+    slow_seconds: float = 0.05
+    preempt_at: Optional[int] = None
+    # step-function faults (used by chaos_runner, counted in runner steps)
+    hang_steps: Sequence[int] = ()
+    hang_seconds: float = 0.0
+
+
+class ChaosDataSource:
+    """Iterator over (x, y, mask) batches with configured fault injection.
+
+    ``batches`` is materialized up front so the source can re-yield the
+    batch a failed fetch pointed at.  Each fetch failure and the
+    preemption fire exactly once; position (``index``) survives both, so
+    a resumed run continues from the next un-consumed batch.
+    """
+
+    def __init__(self, batches, config: ChaosConfig):
+        self.batches = [_normalize(b) for b in batches]
+        self.config = config
+        self.index = 0
+        self._failed: set = set()
+        self._preempted = False
+
+    def __iter__(self) -> "ChaosDataSource":
+        return self
+
+    def __len__(self) -> int:
+        return len(self.batches)
+
+    def __next__(self):
+        i = self.index
+        if i >= len(self.batches):
+            raise StopIteration
+        cfg = self.config
+        if cfg.preempt_at == i and not self._preempted:
+            self._preempted = True
+            raise SimulatedPreemption(f"chaos: preemption before batch {i}")
+        if i in cfg.fetch_fail_steps and i not in self._failed:
+            self._failed.add(i)
+            raise OSError(f"chaos: injected fetch failure at batch {i}")
+        if i in cfg.slow_fetch_steps:
+            time.sleep(cfg.slow_seconds)
+        self.index = i + 1
+        x, y, mask = self.batches[i]
+        if i in cfg.nan_steps:
+            x = np.full_like(np.asarray(x, dtype=np.float32), np.nan)
+        return x, y, mask
+
+
+class _ChaosRunner:
+    """Runner proxy whose fit_batch hangs at configured step indices."""
+
+    def __init__(self, runner, config: ChaosConfig):
+        self._runner = runner
+        self._config = config
+        self._calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self._runner, name)
+
+    def fit_batch(self, x, y, mask=None):
+        call = self._calls
+        self._calls += 1
+        if call in self._config.hang_steps and self._config.hang_seconds:
+            time.sleep(self._config.hang_seconds)
+        return self._runner.fit_batch(x, y, mask)
+
+
+def chaos_runner(runner, config: ChaosConfig):
+    """Wrap a runner so its ``fit_batch`` sleeps ``config.hang_seconds``
+    at each step index in ``config.hang_steps`` — drives the watchdog
+    path.  All other attributes delegate to the wrapped runner."""
+    return _ChaosRunner(runner, config)

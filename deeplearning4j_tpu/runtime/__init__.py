@@ -11,9 +11,12 @@ from deeplearning4j_tpu.runtime.checkpoint import (
     CheckpointListener,
     DiskModelSaver,
     ModelSaver,
+    best_checkpoint,
+    latest_checkpoint,
     load_checkpoint,
     load_model,
     load_params,
+    read_manifest,
     save_checkpoint,
     save_model,
     save_params,
@@ -39,6 +42,9 @@ __all__ = [
     "load_params",
     "save_checkpoint",
     "load_checkpoint",
+    "latest_checkpoint",
+    "best_checkpoint",
+    "read_manifest",
     "ModelSaver",
     "DiskModelSaver",
     "AsyncCheckpointListener",

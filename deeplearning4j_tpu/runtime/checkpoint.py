@@ -176,16 +176,26 @@ def _host_suffix() -> str:
 def save_checkpoint(directory: os.PathLike, step: int, params: PyTree,
                     updater_state: Optional[PyTree] = None,
                     extra: Optional[dict] = None,
-                    keep: int = 3) -> pathlib.Path:
+                    keep: int = 3, score: Optional[float] = None,
+                    keep_best: bool = True,
+                    net_state: Optional[PyTree] = None) -> pathlib.Path:
     """Write checkpoint `step` under `directory/ckpt-{step}/`. Each host
     writes its own addressable shard file; on a single host this is one
-    file. Retains the newest `keep` checkpoints."""
+    file. Retains the newest `keep` checkpoints; with a `score` (a loss —
+    lower is better) the directory manifest tracks the best-scoring
+    checkpoint and `keep_best=True` protects it from GC even when it
+    falls out of the newest-`keep` window.  `net_state` additionally
+    persists non-parameter layer state (batch-norm running stats) — the
+    resilience supervisor saves it so rollback/resume can't revive
+    poisoned or stale statistics."""
     directory = pathlib.Path(directory)
     ckpt = directory / f"ckpt-{step}"
     ckpt.mkdir(parents=True, exist_ok=True)
     tree_to_npz(ckpt / f"params.{_host_suffix()}.npz", params)
     if updater_state is not None:
         tree_to_npz(ckpt / f"updater.{_host_suffix()}.npz", updater_state)
+    if net_state is not None:
+        tree_to_npz(ckpt / f"state.{_host_suffix()}.npz", net_state)
     multi_host = jax.process_count() > 1
     if multi_host:
         # Barrier: every host's shard must be durable before anyone can
@@ -197,13 +207,90 @@ def save_checkpoint(directory: os.PathLike, step: int, params: PyTree,
         meta = {"step": int(step), "processes": int(jax.process_count()),
                 "extra": extra or {},
                 "saved_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
+        if score is not None:
+            meta["score"] = float(score)
         (ckpt / "meta.json").write_text(json.dumps(meta, indent=2))
         # COMMIT marker makes partially-written checkpoints detectable.
         (ckpt / "COMMIT").write_text("ok")
-        _gc_checkpoints(directory, keep)
+        manifest = read_manifest(directory)
+        entry = {"saved_at": meta["saved_at"]}
+        if score is not None:
+            entry["score"] = float(score)
+        manifest["entries"][str(int(step))] = entry
+        best = _best_step(manifest)
+        manifest["best_step"] = best
+        protect = frozenset({best}) if (keep_best and best is not None) \
+            else frozenset()
+        removed = _gc_checkpoints(directory, keep, protect=protect)
+        for s in removed:
+            manifest["entries"].pop(str(s), None)
+        _write_manifest(directory, manifest)
     if multi_host:
         multihost_utils.sync_global_devices(f"ckpt-{step}-committed")
     return ckpt
+
+
+# --------------------------------------------------------------------------
+# Retention manifest: per-step scores + the best-scoring checkpoint
+
+def read_manifest(directory: os.PathLike) -> dict:
+    """The directory's retention manifest ({entries: {step: {score,
+    saved_at}}, best_step}). Missing or corrupt manifests return an empty
+    one — the manifest is an index, never the source of truth (COMMIT
+    markers are)."""
+    path = pathlib.Path(directory) / "manifest.json"
+    empty = {"format": 1, "entries": {}, "best_step": None}
+    if not path.exists():
+        return empty
+    try:
+        m = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return empty
+    if not isinstance(m.get("entries"), dict):
+        return empty
+    return m
+
+
+def _write_manifest(directory: pathlib.Path, manifest: dict) -> None:
+    path = directory / "manifest.json"
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".manifest")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(json.dumps(manifest, indent=2))
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def _best_step(manifest: dict) -> Optional[int]:
+    scored = [(e["score"], int(s)) for s, e in manifest["entries"].items()
+              if isinstance(e, dict) and "score" in e]
+    if not scored:
+        return None
+    # lowest loss wins; newest breaks ties
+    return min(scored, key=lambda t: (t[0], -t[1]))[1]
+
+
+def best_checkpoint(directory: os.PathLike) -> Optional[pathlib.Path]:
+    """The committed checkpoint with the best (lowest) recorded score,
+    None when no scored checkpoint exists."""
+    directory = pathlib.Path(directory)
+    best = read_manifest(directory).get("best_step")
+    if best is None:
+        return None
+    ckpt = directory / f"ckpt-{best}"
+    return ckpt if (ckpt / "COMMIT").exists() else None
+
+
+def load_net_state(ckpt: os.PathLike, like: PyTree) -> Optional[PyTree]:
+    """Layer state (batch-norm running stats) from a checkpoint dir, in
+    the structure of `like`; None when the checkpoint predates net_state
+    or none was saved."""
+    path = pathlib.Path(ckpt) / f"state.{_host_suffix()}.npz"
+    if not path.exists():
+        return None
+    return npz_to_tree(path, like)
 
 
 def latest_checkpoint(directory: os.PathLike) -> Optional[pathlib.Path]:
@@ -225,10 +312,15 @@ def load_checkpoint(directory: os.PathLike, params_like: PyTree,
                     step: Optional[int] = None
                     ) -> Tuple[int, PyTree, Optional[PyTree], dict]:
     """Returns (step, params, updater_state, extra). With `step=None`,
-    restores the newest committed checkpoint."""
+    restores the newest committed checkpoint; `step="best"` restores the
+    best-scoring one per the retention manifest."""
     directory = pathlib.Path(directory)
-    ckpt = (directory / f"ckpt-{step}" if step is not None
-            else latest_checkpoint(directory))
+    if step == "best":
+        ckpt = best_checkpoint(directory)
+    elif step is not None:
+        ckpt = directory / f"ckpt-{step}"
+    else:
+        ckpt = latest_checkpoint(directory)
     if (ckpt is None or not ckpt.exists()
             or not (ckpt / "COMMIT").exists()):
         raise FileNotFoundError(f"no committed checkpoint under {directory}")
@@ -241,15 +333,23 @@ def load_checkpoint(directory: os.PathLike, params_like: PyTree,
     return meta["step"], params, upd, meta.get("extra", {})
 
 
-def _gc_checkpoints(directory: pathlib.Path, keep: int) -> None:
+def _gc_checkpoints(directory: pathlib.Path, keep: int,
+                    protect: frozenset = frozenset()) -> list:
+    """Remove all but the newest `keep` checkpoints, never touching steps
+    in `protect` (best-score retention). Returns the removed steps."""
     ckpts = sorted(
         (int(m.group(1)), child)
         for child in directory.iterdir()
         if (m := re.fullmatch(r"ckpt-(\d+)", child.name)))
-    for _, child in ckpts[:-keep] if keep > 0 else []:
+    removed = []
+    for step, child in ckpts[:-keep] if keep > 0 else []:
+        if step in protect:
+            continue
         for f in child.iterdir():
             f.unlink()
         child.rmdir()
+        removed.append(step)
+    return removed
 
 
 # --------------------------------------------------------------------------
@@ -288,7 +388,7 @@ class CheckpointListener:
         upd = published_updater_state(model) if self.save_updater else None
         save_checkpoint(self.directory, iteration, model.params,
                         updater_state=upd, extra={"score": float(score)},
-                        keep=self.keep)
+                        keep=self.keep, score=float(score))
 
 
 class AsyncCheckpointListener(CheckpointListener):
@@ -338,7 +438,8 @@ class AsyncCheckpointListener(CheckpointListener):
                 step, params, upd, score = item
                 save_checkpoint(self.directory, step, params,
                                 updater_state=upd,
-                                extra={"score": score}, keep=self.keep)
+                                extra={"score": score}, keep=self.keep,
+                                score=score)
             except Exception as e:  # noqa: BLE001 — surfaced on next call
                 self._errors.append(e)
             finally:

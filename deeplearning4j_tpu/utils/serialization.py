@@ -22,7 +22,7 @@ def save_object(obj: Any, path: os.PathLike) -> None:
         with os.fdopen(fd, "wb") as f:
             pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
         os.replace(tmp, path)
-    except BaseException:
+    except BaseException:  # noqa: BLE001 — cleanup, re-raised
         try:
             os.unlink(tmp)
         except OSError:

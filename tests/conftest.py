@@ -28,6 +28,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running quality gates (deselect with "
         "-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection recovery tests (CPU-only, "
+        "fast; run in tier-1)")
 
 
 @pytest.fixture

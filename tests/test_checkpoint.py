@@ -94,13 +94,93 @@ class TestTrainStateCheckpoint:
             save_checkpoint(tmp_path, step, net.params, keep=3)
         latest = latest_checkpoint(tmp_path)
         assert latest.name == "ckpt-5"
-        kept = sorted(p.name for p in tmp_path.iterdir())
+        kept = sorted(p.name for p in tmp_path.iterdir()
+                      if p.name.startswith("ckpt-"))
         assert kept == ["ckpt-3", "ckpt-4", "ckpt-5"]
 
     def test_missing_checkpoint_raises(self, tmp_path):
         net = small_net()
         with pytest.raises(FileNotFoundError):
             load_checkpoint(tmp_path / "nope", net.params)
+
+
+class TestRetentionAndCrashSafety:
+    def test_crash_mid_save_loads_newest_complete(self, tmp_path):
+        """A partial write (params present, no COMMIT marker — the crash
+        window of save_checkpoint) alongside a valid older checkpoint:
+        loading must pick the newest COMPLETE one, never the partial."""
+        from deeplearning4j_tpu.runtime.checkpoint import tree_to_npz
+
+        net = small_net()
+        x, y = batch()
+        net.fit_batch(x, y)
+        save_checkpoint(tmp_path, 5, net.params,
+                        updater_state=net.updater_state)
+        # simulate the crash: step-7 directory with data but no COMMIT
+        partial = tmp_path / "ckpt-7"
+        partial.mkdir()
+        tree_to_npz(partial / "params.proc00000.npz", net.params)
+        assert latest_checkpoint(tmp_path).name == "ckpt-5"
+        step, params, _upd, _extra = load_checkpoint(tmp_path, net.params)
+        assert step == 5
+        from jax.flatten_util import ravel_pytree
+
+        np.testing.assert_allclose(np.asarray(ravel_pytree(params)[0]),
+                                   np.asarray(ravel_pytree(net.params)[0]),
+                                   atol=0)
+
+    def test_best_score_checkpoint_survives_gc(self, tmp_path):
+        """keep-last-K plus best-score retention: the lowest-loss
+        checkpoint outlives the newest-K window."""
+        from deeplearning4j_tpu.runtime.checkpoint import (
+            best_checkpoint,
+            read_manifest,
+        )
+
+        net = small_net()
+        scores = {1: 1.0, 2: 0.2, 3: 0.5, 4: 0.6, 5: 0.7, 6: 0.8}
+        for step, score in scores.items():
+            save_checkpoint(tmp_path, step, net.params, keep=2,
+                            score=score)
+        kept = sorted(p.name for p in tmp_path.iterdir()
+                      if p.name.startswith("ckpt-"))
+        assert kept == ["ckpt-2", "ckpt-5", "ckpt-6"]  # best + newest 2
+        assert best_checkpoint(tmp_path).name == "ckpt-2"
+        assert latest_checkpoint(tmp_path).name == "ckpt-6"
+        manifest = read_manifest(tmp_path)
+        assert manifest["best_step"] == 2
+        assert manifest["entries"]["2"]["score"] == 0.2
+        # GC'd steps left the manifest
+        assert "1" not in manifest["entries"]
+
+    def test_load_best_checkpoint(self, tmp_path):
+        net = small_net()
+        x, y = batch()
+        save_checkpoint(tmp_path, 1, net.params, score=0.1)
+        net.fit_batch(x, y)
+        save_checkpoint(tmp_path, 2, net.params, score=0.9)
+        step, _params, _upd, _extra = load_checkpoint(
+            tmp_path, net.params, step="best")
+        assert step == 1
+
+    def test_unscored_checkpoints_keep_plain_retention(self, tmp_path):
+        net = small_net()
+        for step in (1, 2, 3, 4, 5):
+            save_checkpoint(tmp_path, step, net.params, keep=3)
+        kept = sorted(p.name for p in tmp_path.iterdir()
+                      if p.name.startswith("ckpt-"))
+        assert kept == ["ckpt-3", "ckpt-4", "ckpt-5"]
+
+    def test_corrupt_manifest_is_tolerated(self, tmp_path):
+        from deeplearning4j_tpu.runtime.checkpoint import read_manifest
+
+        net = small_net()
+        save_checkpoint(tmp_path, 1, net.params, score=0.5)
+        (tmp_path / "manifest.json").write_text("{not json")
+        assert read_manifest(tmp_path)["entries"] == {}
+        # saving keeps working and rebuilds the manifest
+        save_checkpoint(tmp_path, 2, net.params, score=0.4)
+        assert read_manifest(tmp_path)["best_step"] == 2
 
 
 class TestCheckpointListener:

@@ -340,3 +340,24 @@ def test_train_spmd_sync_every(tmp_path, iris_svmlight, model_json,
     assert "local-SGD mode, averaging every 4 steps" in got
     acc = float(re.search(r"Accuracy:\s+([0-9.]+)", got).group(1))
     assert acc >= 0.85, got
+
+
+@pytest.mark.chaos
+def test_train_resilience_checkpoints_and_resumes(tmp_path, iris_svmlight,
+                                                  model_json, capsys):
+    """-resilience supervises training (periodic checkpoints + manifest)
+    and a second invocation resumes from the newest checkpoint."""
+    args = ["train", "-input", str(iris_svmlight), "-model",
+            str(model_json), "-output", str(tmp_path / "m"),
+            "-epochs", "4", "-batch", "32", "-resilience",
+            "-ckpt-every", "5"]
+    assert main(args) == 0
+    got = capsys.readouterr().out
+    assert "resilience: completed" in got
+    ckpts = tmp_path / "m" / "ckpts"
+    assert (ckpts / "manifest.json").exists()
+    assert any(p.name.startswith("ckpt-") for p in ckpts.iterdir())
+
+    assert main(args) == 0
+    got = capsys.readouterr().out
+    assert "resilience: resumed from checkpoint step" in got

@@ -1,0 +1,36 @@
+"""Tier-1 wiring for tools/lint_excepts.py: the package must not grow
+new broad exception handlers (see ISSUE 1 / docs/robustness.md)."""
+
+import pathlib
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+sys.path.insert(0, str(REPO / "tools"))
+import lint_excepts  # noqa: E402
+
+pytestmark = pytest.mark.chaos
+
+
+def test_no_unjustified_broad_excepts():
+    assert lint_excepts.main([str(REPO)]) == 0
+
+
+def test_linter_catches_bare_and_broad_handlers(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "try:\n    pass\nexcept Exception:\n    pass\n"
+        "try:\n    pass\nexcept (ValueError, BaseException):\n    pass\n"
+        "try:\n    pass\nexcept:\n    pass\n")
+    assert len(list(lint_excepts.broad_handlers(bad))) == 3
+
+
+def test_linter_accepts_pragma_and_narrow_handlers(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "try:\n    pass\n"
+        "except Exception:  # noqa: BLE001 — justified fallback\n    pass\n"
+        "try:\n    pass\nexcept (OSError, ValueError):\n    pass\n")
+    assert list(lint_excepts.broad_handlers(ok)) == []

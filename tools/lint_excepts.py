@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Fail on new broad exception handlers in deeplearning4j_tpu/.
+
+A bare ``except:`` / ``except Exception:`` / ``except BaseException:``
+swallows real bugs (AttributeError from a typo looks exactly like a
+network flake) and is how the NaN-eats-the-run class of failures hides.
+The resilience subsystem narrows every handler it owns; this check keeps
+the codebase from growing new broad ones.
+
+A broad handler is allowed only when the ``except`` line carries an
+explicit ``noqa: BLE001`` pragma (with a justification comment) or the
+file has an entry in ALLOWLIST below.  Run directly or via
+tests/test_lint_excepts.py (tier-1).
+
+Usage: python tools/lint_excepts.py [root]
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+# path (relative to repo root) -> max number of un-pragma'd broad handlers
+# tolerated.  Keep this EMPTY: new broad handlers should either be
+# narrowed or carry a justified `noqa: BLE001` pragma on the except line.
+ALLOWLIST: dict = {}
+
+PACKAGE = "deeplearning4j_tpu"
+PRAGMA = "noqa: BLE001"
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    """True for ``except:``, ``except Exception``, ``except BaseException``,
+    including tuple forms that contain either."""
+    t = handler.type
+    if t is None:
+        return True
+
+    def broad_name(node) -> bool:
+        return isinstance(node, ast.Name) and node.id in (
+            "Exception", "BaseException")
+
+    if isinstance(t, ast.Tuple):
+        return any(broad_name(el) for el in t.elts)
+    return broad_name(t)
+
+
+def broad_handlers(path: pathlib.Path):
+    """Yield (lineno, line) for each un-pragma'd broad handler in `path`."""
+    source = path.read_text()
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        yield (e.lineno or 0, f"<syntax error: {e}>")
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and _is_broad(node):
+            line = lines[node.lineno - 1]
+            if PRAGMA not in line:
+                yield (node.lineno, line.strip())
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = pathlib.Path(argv[0]) if argv else \
+        pathlib.Path(__file__).resolve().parent.parent
+    pkg = root / PACKAGE
+    failures = []
+    for path in sorted(pkg.rglob("*.py")):
+        rel = str(path.relative_to(root))
+        found = list(broad_handlers(path))
+        allowed = ALLOWLIST.get(rel, 0)
+        if len(found) > allowed:
+            for lineno, line in found[allowed:]:
+                failures.append(f"{rel}:{lineno}: broad except handler "
+                                f"without '{PRAGMA}' pragma: {line}")
+    if failures:
+        print(f"{len(failures)} broad exception handler(s) found — narrow "
+              f"the exception types (see resilience/retry.py for the "
+              f"transient-failure pattern), or justify with a "
+              f"'# {PRAGMA} — <reason>' pragma:")
+        for f in failures:
+            print(" ", f)
+        return 1
+    print("lint_excepts: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,6 +171,13 @@ def cmd_train(args) -> int:
     if accum > 1 and runner is not net:
         print("-accum is a local-runtime feature; ignored under spmd")
         accum = 1
+    chunk = max(1, int(props.get("train.chunk.size", args.chunk)))
+    if chunk > 1 and accum > 1:
+        print("-accum is ignored with -chunk (a chunk scans batches)")
+        accum = 1
+    if chunk > 1 and runner is not net and runner.sync_every != 1:
+        print("-chunk needs plain sync spmd; ignored under -sync-every > 1")
+        chunk = 1
     if args.resilience:
         # Supervised training: poison-batch skipping, divergence rollback,
         # retrying fetches, preemption-safe checkpointing.  The health
@@ -190,7 +197,8 @@ def cmd_train(args) -> int:
             keep=args.ckpt_keep,
             skip_budget=args.skip_budget,
             divergence_factor=args.divergence_factor,
-            step_timeout=args.step_timeout))
+            step_timeout=args.step_timeout,
+            chunk_size=chunk))
         sup.install_signal_handlers()
         stream = _batches()
         if sup.resume():
@@ -217,6 +225,12 @@ def cmd_train(args) -> int:
         if report.preempted:
             print(f"resilience: preempted — emergency checkpoint at step "
                   f"{report.steps}; re-run the same command to resume")
+    elif chunk > 1:
+        # Fused multi-step driver: K steps per dispatch, the assembler/
+        # device-prefetch/dispatch stages pipelined (runtime/fused.py).
+        from deeplearning4j_tpu.runtime.fused import FusedTrainingDriver
+
+        FusedTrainingDriver(runner, chunk_size=chunk).fit(_batches())
     else:
         last = None
         for b in PrefetchDataSetIterator(_batches()):
@@ -597,6 +611,13 @@ def build_parser() -> argparse.ArgumentParser:
     p_train.add_argument("-accum", "--accum", type=int, default=1,
                          help="gradient-accumulation microbatches per "
                               "update (local runtime)")
+    p_train.add_argument("-chunk", "--chunk", type=int, default=1,
+                         help="fused multi-step driver: optimizer steps "
+                              "per XLA dispatch (one host sync per "
+                              "chunk; tail batches padded+masked so the "
+                              "jit cache stays warm; with -resilience, "
+                              "health checks read per-step loss vectors "
+                              "and faults replay at chunk 1)")
     p_train.add_argument("-sync-every", "--sync-every", type=int,
                          default=1,
                          help="spmd runtime: average replicas every N "

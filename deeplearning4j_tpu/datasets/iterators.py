@@ -148,16 +148,24 @@ class PrefetchDataSetIterator(DataSetIterator):
     staged while the device trains on batch b, so input IO never blocks the
     TPU step. Plays the role of the reference's async fetcher/queue pattern
     (BaseDataFetcher + DiskBasedQueue) with a bounded queue for backpressure.
+
+    ``transform`` runs on the producer thread before the item is queued —
+    the device-prefetch hook: the fused training driver (runtime/fused.py)
+    passes its runner's ``stage_chunk`` here so stacking + `device_put`
+    (with the right `NamedSharding` in the data-parallel case) of chunk
+    i+1 overlaps chunk i's compute.
     """
 
     _DONE = object()
 
-    def __init__(self, base: DataSetIterator, depth: int = 2):
+    def __init__(self, base: DataSetIterator, depth: int = 2,
+                 transform=None):
         import queue as _queue
         import threading as _threading
 
         self.base = base
         self.depth = max(1, depth)
+        self.transform = transform
         self._queue_mod = _queue
         self._threading = _threading
 
@@ -182,6 +190,8 @@ class PrefetchDataSetIterator(DataSetIterator):
         def producer():
             try:
                 for item in self.base:
+                    if self.transform is not None:
+                        item = self.transform(item)
                     if not put_until_stopped(item):
                         return
             except Exception as e:  # noqa: BLE001 — re-raise on consumer side

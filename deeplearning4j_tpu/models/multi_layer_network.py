@@ -55,6 +55,16 @@ PyTree = Any
 # with a one-time warning.
 _SOLVER_CACHE_MAX = 8
 
+# Unroll cap for the fused multi-step train chunk: compile time grows
+# linearly with the unroll factor, so it is bounded regardless of chunk
+# size.  unroll=1 (the default everywhere) keeps the scan rolled — ONE
+# compiled body shared by every trip count, which is what makes chunked
+# and unchunked training bitwise-identical.  unroll>1 lets XLA fuse
+# ACROSS steps — measurably faster on CPU, but the cross-step fusion
+# (FMA contraction, reassociation) changes low-order bits, so results
+# are then only approximately chunk-size invariant (~1e-7 relative).
+_CHUNK_UNROLL_CAP = 16
+
 # Fused logit-space losses for stability: (activation, loss) -> fused loss name.
 _FUSED = {
     ("softmax", "mcxent"): "mcxent_with_logits",
@@ -125,8 +135,9 @@ class MultiLayerNetwork:
         # only when a health check reads it).
         self._lr_scale = 1.0
         self.last_grad_norm: Optional[jax.Array] = None
-        self._listeners: list = []
+        self._listeners: list = []  # [(fn, sync_interval)]
         self._jit_train_step = None
+        self._jit_train_chunk = None
         self._jit_forward = None
         self._jit_score = None
         self._iteration = 0
@@ -161,11 +172,33 @@ class MultiLayerNetwork:
         """IterationListener parity (reference optimize/api/IterationListener):
         either a plain fn(iteration:int, score:float) or an object with
         iteration_done(model, iteration, score) (optimize.api listeners,
-        runtime.CheckpointListener)."""
+        runtime.CheckpointListener).
+
+        A listener may declare a ``sync_interval`` attribute (e.g.
+        `ScoreIterationListener` sets it to its reporting interval):
+        iterations that are not a multiple of it never call the listener —
+        and, crucially, never force the loss to the host, so off-interval
+        steps keep pipelining on the device.
+
+        ``score_only`` (optimize.api.IterationListener) governs chunked
+        fit: score-only listeners (and plain fns, which never see the
+        model) receive every due per-step score out of a chunk's loss
+        vector; model-reading listeners fire only at chunk boundaries,
+        where the model state matches the iteration label."""
+        interval = max(1, int(getattr(fn, "sync_interval", 1)))
+        score_only = bool(getattr(fn, "score_only", False))
         if hasattr(fn, "iteration_done"):
             obj = fn
             fn = lambda it, score: obj.iteration_done(self, it, score)  # noqa: E731
-        self._listeners.append(fn)
+        else:
+            score_only = True  # plain fn(it, score): never sees the model
+        self._listeners.append((fn, interval, score_only))
+
+    def _due_listeners(self, iteration: int) -> list:
+        """Listeners whose sync_interval divides `iteration` — the only
+        ones worth paying a host sync for this step."""
+        return [fn for fn, interval, _ in self._listeners
+                if iteration % interval == 0]
 
     # ---- functional forward ----------------------------------------------
 
@@ -257,6 +290,76 @@ class MultiLayerNetwork:
                     jnp.sum(jnp.abs(v)) for v in p_i.values())
         return loss, new_state
 
+    def _weighted_loss_sums(self, params, state, x, y, rng, mask, w):
+        """The UNNORMALIZED pieces of the example-weighted loss:
+        (weighted per-example loss sum, weight sum, new_state), no
+        regularization.  The single-device chunk step normalizes locally;
+        the data-parallel chunk step `psum`s numerator and denominator
+        across shards BEFORE dividing, so padded tail rows distributed
+        unevenly over the mesh still yield the exact global weighted
+        mean."""
+        lc = self.conf.layers[-1]
+        loss_name = getattr(lc, "loss", "mse")
+        fused = _FUSED.get((lc.activation.lower(), loss_name.lower()))
+        if isinstance(lc, (OutputLayerConf, RnnOutputLayerConf)) and fused:
+            out, new_state = self._logits_forward(params, state, x,
+                                                  train=True, rng=rng,
+                                                  mask=mask)
+            loss_name = fused
+        else:
+            out, new_state = self._forward(params, state, x, train=True,
+                                           rng=rng, mask=mask)
+        out = out.astype(jnp.float32)  # loss always in f32 (see _objective)
+        loss_fn = losses_mod.get_loss(loss_name)
+        if out.ndim == 3:
+            # Sequence outputs: fold the example weight into the [B, T]
+            # time mask (all-ones when absent) — padded rows become
+            # all-zero mask rows, exactly like _masked_loss.
+            m = (mask if mask is not None
+                 else jnp.ones(out.shape[:2], jnp.float32))
+            m = m * w[:, None]
+            flat_y = y.reshape((-1, y.shape[-1]))
+            flat_o = out.reshape((-1, out.shape[-1]))
+            per = jax.vmap(lambda yy, oo: loss_fn(yy[None], oo[None]))(
+                flat_y, flat_o)
+            mm = m.reshape(-1).astype(per.dtype)
+            return jnp.sum(per * mm), jnp.sum(mm), new_state
+        per = jax.vmap(lambda yy, oo: loss_fn(yy[None], oo[None]))(y, out)
+        ww = w.astype(per.dtype)
+        return jnp.sum(per * ww), jnp.sum(ww), new_state
+
+    def _reg_loss(self, params) -> jax.Array:
+        """The per-layer L1/L2 term of `_objective`, standalone — the
+        data-parallel chunk step adds its gradient once after the psum
+        (it is replicated, not data-dependent)."""
+        loss = jnp.asarray(0.0, jnp.float32)
+        for lc_i, p_i in zip(self.conf.layers, params):
+            if lc_i.l2:
+                loss = loss + 0.5 * lc_i.l2 * sum(
+                    jnp.sum(jnp.square(v)) for v in p_i.values())
+            if lc_i.l1:
+                loss = loss + lc_i.l1 * sum(
+                    jnp.sum(jnp.abs(v)) for v in p_i.values())
+        return loss
+
+    def _has_reg(self) -> bool:
+        return any(lc.l1 or lc.l2 for lc in self.conf.layers)
+
+    def _weighted_objective(self, params, state, x, y, rng, mask, w):
+        """`_objective` with [batch] example weights: the fused chunk
+        step's per-step loss.  Padded tail rows (w == 0) contribute
+        nothing to the loss or gradient, and the normalizer is the weight
+        sum — so one padded program replaces a per-tail-shape recompile.
+        Every chunk step uses this SAME weighted form (all-ones w for
+        full batches), which is what makes different chunk sizes execute
+        bit-identical per-step programs."""
+        num, den, new_state = self._weighted_loss_sums(
+            params, state, x, y, rng, mask, w)
+        loss = num / jnp.maximum(den, 1.0)
+        if self._has_reg():
+            loss = loss + self._reg_loss(params)
+        return loss, new_state
+
     # ---- jitted steps -----------------------------------------------------
 
     def _apply_lr_multipliers(self, updates):
@@ -344,6 +447,122 @@ class MultiLayerNetwork:
 
         return train_step
 
+    def _make_train_chunk(self, has_mask: bool, unroll: int = 1):
+        """The fused multi-step program: K optimizer steps inside one
+        jitted `lax.scan` over stacked batches.  Per-step RNG is the same
+        `fold_in(PRNGKey(seed), iteration)` the per-batch path uses (it0
+        is a traced scalar, so advancing iterations never recompiles),
+        lr_scale stays traced for the supervisor's backoff, and the carry
+        (params / layer state / updater state) is donated.  Returns the
+        per-step losses and global grad norms as [K] device vectors —
+        one host sync per CHUNK instead of per step.
+
+        `unroll=1` (default) keeps the scan rolled: one compiled body for
+        any trip count, so chunked == unchunked bit-for-bit.  `unroll>1`
+        trades that for cross-step XLA fusion (see _CHUNK_UNROLL_CAP)."""
+        updater = self._updater
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def train_chunk(params, state, upd_state, xs, ys, ws, masks, it0,
+                        lr_scale):
+            base = jax.random.PRNGKey(self.conf.conf.seed)
+
+            def body(carry, inp):
+                params, state, upd = carry
+                if has_mask:
+                    xi, yi, wi, mi, it = inp
+                else:
+                    (xi, yi, wi, it), mi = inp, None
+                rng = jax.random.fold_in(base, it)
+
+                def lossfn(p):
+                    return self._weighted_objective(p, state, xi, yi, rng,
+                                                    mi, wi)
+
+                (loss, new_state), grads = jax.value_and_grad(
+                    lossfn, has_aux=True)(params)
+                gnorm = global_grad_norm(grads)
+                updates, upd = updater.update(grads, upd, params)
+                updates = self._apply_lr_multipliers(updates)
+                updates = jax.tree_util.tree_map(lambda u: u * lr_scale,
+                                                 updates)
+                params = apply_updates(params, updates)
+                return (params, new_state, upd), (loss, gnorm)
+
+            its = it0 + jnp.arange(xs.shape[0])
+            inputs = ((xs, ys, ws, masks, its) if has_mask
+                      else (xs, ys, ws, its))
+            (params, state, upd_state), (losses, gnorms) = lax.scan(
+                body, (params, state, upd_state), inputs,
+                unroll=min(int(xs.shape[0]), unroll, _CHUNK_UNROLL_CAP))
+            return params, state, upd_state, losses, gnorms
+
+        return train_chunk
+
+    def fit_chunk_async(self, xs, ys, masks=None, weights=None,
+                        unroll: int = 1) -> Tuple[jax.Array, jax.Array]:
+        """K = xs.shape[0] optimizer steps in ONE XLA dispatch — the
+        fused driver's primitive (runtime/fused.py).  Inputs are stacked
+        [K, B, ...]; `weights` [K, B] zeroes out padded tail rows.
+        Returns (losses, grad_norms) as [K] DEVICE vectors; the single
+        host sync per chunk happens here only when a listener is due."""
+        if self.params is None:
+            self.init()
+        self._updater_state_owner = None
+        if self.updater_state is None:
+            self.updater_state = self._updater.init(self.params)
+        xs = jnp.asarray(xs)
+        ys = jnp.asarray(ys)
+        masks = None if masks is None else jnp.asarray(masks)
+        k = int(xs.shape[0])
+        if weights is None:
+            weights = jnp.ones(xs.shape[:2], jnp.float32)
+        else:
+            weights = jnp.asarray(weights, jnp.float32)
+        if self._jit_train_chunk is None:
+            self._jit_train_chunk = {}
+        key = (masks is not None, max(1, int(unroll)))
+        step = self._jit_train_chunk.get(key)
+        if step is None:
+            step = self._jit_train_chunk[key] = \
+                self._make_train_chunk(key[0], key[1])
+        it0 = self._iteration
+        (self.params, self.state, self.updater_state, losses, gnorms) = step(
+            self.params, self.state, self.updater_state, xs, ys, weights,
+            masks, jnp.asarray(it0, jnp.int32),
+            jnp.asarray(self._lr_scale, jnp.float32))
+        self._iteration += k
+        self.last_grad_norm = gnorms[-1]
+        self._fire_chunk_listeners(it0, k, losses)
+        return losses, gnorms
+
+    def _fire_chunk_listeners(self, it0: int, k: int, losses) -> None:
+        """Fire due listeners for iterations it0+1..it0+k with AT MOST one
+        host sync for the whole chunk (and none when nothing is due).
+        Model-reading listeners (score_only=False) fire only for the
+        chunk's FINAL iteration — mid-chunk the live model already holds
+        end-of-chunk state, so an earlier label would lie (e.g. a
+        checkpoint listener would save step-K params under step i)."""
+        if not self._listeners:
+            return
+        due = [(it, fn)
+               for it in range(it0 + 1, it0 + k + 1)
+               for fn, interval, score_only in self._listeners
+               if it % interval == 0 and (score_only or it == it0 + k)]
+        if not due:
+            return
+        loss_host = np.asarray(losses)  # the one sync
+        for it, fn in due:
+            fn(it, float(loss_host[it - it0 - 1]))
+
+    def stage_chunk(self, chunk):
+        """Place an assembled HostChunk's arrays on device (the fused
+        driver's prefetch hook; runs on the producer thread)."""
+        put = lambda a: None if a is None else jax.device_put(a)  # noqa: E731
+        return chunk._replace(xs=put(chunk.xs), ys=put(chunk.ys),
+                              weights=put(chunk.weights),
+                              masks=put(chunk.masks))
+
     def fit_batch_async(self, x, y, mask=None, accum_steps: int = 1
                         ) -> jax.Array:
         """One SGD step; returns the loss as a DEVICE array without
@@ -386,9 +605,13 @@ class MultiLayerNetwork:
             self.params, self.state, self.updater_state, x, y, rng, mask,
             lr_scale)
         self._iteration += 1
-        if self._listeners:
+        due = self._due_listeners(self._iteration)
+        if due:
+            # Only a DUE listener forces the loss to the host; off-interval
+            # steps (ScoreIterationListener between reports) keep the step
+            # fully async.
             loss_f = float(loss)
-            for listener in self._listeners:
+            for listener in due:
                 listener(self._iteration, loss_f)
         return loss
 
@@ -437,13 +660,25 @@ class MultiLayerNetwork:
         self._iteration = int(step)
         self._updater_state_owner = None
 
-    def fit(self, data, epochs: int = 1, accum_steps: int = 1
-            ) -> "MultiLayerNetwork":
+    def fit(self, data, epochs: int = 1, accum_steps: int = 1,
+            chunk_size: Optional[int] = None, prefetch: int = 2,
+            chunk_unroll: int = 1) -> "MultiLayerNetwork":
         """Train from a DataSetIterator-like iterable (yielding objects with
         .features/.labels/.mask or (x, y) tuples) or a single (x, y) pair.
         Runs `conf.pretrain` greedy pretraining first if configured
         (reference fit(DataSetIterator) :1028).  accum_steps > 1 applies
-        gradient accumulation to every batch (see fit_batch_async)."""
+        gradient accumulation to every batch (see fit_batch_async).
+
+        `chunk_size` routes the SGD loop through the fused multi-step
+        driver (runtime/fused.py): chunk_size optimizer steps per XLA
+        dispatch, tail batches padded + example-masked so the jit cache
+        stays warm, and the next chunk device-staged on a background
+        thread (`prefetch` chunks deep; 0 disables the thread).  With the
+        default `chunk_unroll=1` every chunk size — including 1 —
+        executes the identical compiled step body, so results are
+        BITWISE chunk-size invariant; `chunk_unroll>1` unrolls the scan
+        for cross-step XLA fusion (faster on CPU, low-order bits then
+        depend on the chunking)."""
         import types
 
         if isinstance(data, types.GeneratorType):
@@ -455,7 +690,23 @@ class MultiLayerNetwork:
             self.pretrain(data, epochs=1)
         algo = self.conf.conf.optimization_algo
         if algo and algo != "stochastic_gradient_descent":
+            if chunk_size is not None:
+                raise ValueError(
+                    "chunk_size applies to the SGD path; the line-search "
+                    f"solvers ({algo}) drive their own compiled loop")
             return self._fit_with_solver(data, epochs, algo)
+        if chunk_size is not None:
+            if accum_steps != 1:
+                raise ValueError(
+                    "chunk_size and accum_steps are mutually exclusive "
+                    "(a chunk scans batches, accumulation scans "
+                    "microbatches of one)")
+            from deeplearning4j_tpu.runtime.fused import FusedTrainingDriver
+
+            FusedTrainingDriver(self, chunk_size=chunk_size,
+                                prefetch=prefetch,
+                                unroll=chunk_unroll).fit(data, epochs=epochs)
+            return self
         loss = None
         for _ in range(epochs):
             for batch in _as_batches(data):
@@ -520,7 +771,7 @@ class MultiLayerNetwork:
                     solver = solvers[key] = make_solver(x, y, mask)
                 loss = solver.fit_model(x, y, mask)
                 self._iteration += 1
-                for listener in self._listeners:
+                for listener in self._due_listeners(self._iteration):
                     listener(self._iteration, float(loss))
             _maybe_reset(data)
         return self
@@ -616,7 +867,12 @@ class MultiLayerNetwork:
     def evaluate(self, x, y, mask=None, batch_size: Optional[int] = None):
         """Classification metrics over a dataset.  `batch_size` evaluates
         in chunks (constant device memory on large test sets); the
-        confusion counts accumulate identically either way."""
+        confusion counts accumulate identically either way.
+
+        Batched eval fast path: the dataset is staged on device ONCE,
+        mini-batches are device-resident slices through the single cached
+        jitted forward, and the predictions come back to the host in ONE
+        transfer at the end — no per-mini-batch asarray round-trips."""
         from deeplearning4j_tpu.evaluation import Evaluation
 
         ev = Evaluation()
@@ -625,12 +881,14 @@ class MultiLayerNetwork:
         if batch_size is None:
             ev.eval(np.asarray(y), np.asarray(self.output(x, mask)))
             return ev
-        x = np.asarray(x)
-        y = np.asarray(y)
-        for i in range(0, len(x), batch_size):
-            m = None if mask is None else mask[i:i + batch_size]
-            ev.eval(y[i:i + batch_size],
-                    np.asarray(self.output(x[i:i + batch_size], m)))
+        xd = jnp.asarray(x)                    # one host->device transfer
+        md = None if mask is None else jnp.asarray(mask)
+        outs = []
+        for i in range(0, int(xd.shape[0]), batch_size):
+            m = None if md is None else md[i:i + batch_size]
+            outs.append(self.output(xd[i:i + batch_size], m))
+        out = np.asarray(jnp.concatenate(outs, axis=0))  # one device->host
+        ev.eval(np.asarray(y), out)
         return ev
 
     # ---- parameter vector view (checkpoint/shipping format) ----------------

@@ -28,7 +28,17 @@ class IterationListener:
 
     Same contract as the reference SPI: `iterationDone(model, iteration)`,
     here enriched with the score so listeners need not recompute it.
+
+    ``score_only = True`` declares that the listener reads ONLY
+    (iteration, score), never the model's parameters/state.  Under fused
+    multi-step training (fit(chunk_size=K)) the model mid-chunk holds
+    END-of-chunk state, so model-reading listeners (checkpointers,
+    histogram publishers — score_only=False, the default) fire only at
+    chunk boundaries where the label matches the state, while score-only
+    listeners still see every due per-step score.
     """
+
+    score_only = False
 
     def iteration_done(self, model, iteration: int, score: float) -> None:
         raise NotImplementedError
@@ -36,11 +46,19 @@ class IterationListener:
 
 class ScoreIterationListener(IterationListener):
     """Logs the score every `print_iterations` iterations
-    (reference `ScoreIterationListener.java:50`)."""
+    (reference `ScoreIterationListener.java:50`).
+
+    Declares ``sync_interval = print_iterations``: the network only
+    forces the (otherwise async) device loss to the host on reporting
+    iterations — off-interval steps never pay a sync for this listener.
+    """
+
+    score_only = True
 
     def __init__(self, print_iterations: int = 10,
                  out: Callable[[str], None] | None = None):
         self.print_iterations = max(1, print_iterations)
+        self.sync_interval = self.print_iterations
         self._out = out or (lambda s: log.info(s))
 
     def iteration_done(self, model, iteration: int, score: float) -> None:
@@ -72,6 +90,8 @@ class NanGuardListener(IterationListener):
     as an attachable listener.  Note: any registered listener forces a
     host sync per step (the score must reach the host to be checked) —
     the same cost the reference pays for its per-step assertion."""
+
+    score_only = True
 
     def iteration_done(self, model, iteration: int, score: float) -> None:
         if not math.isfinite(score):

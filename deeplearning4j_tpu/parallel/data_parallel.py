@@ -95,6 +95,7 @@ class DataParallelTrainer:
             self._step_fn = (self._build_step() if sync_every == 1
                              else self._build_local_step())
         self._avg_fn = None
+        self._chunk_step_fn = {}  # has_mask -> fused K-step program
         self._rep = None  # stacked (params, state, upd_state), local mode
         self._iteration = 0
 
@@ -143,6 +144,156 @@ class DataParallelTrainer:
             check_rep=False,
         )
         return jax.jit(fn)
+
+    def _build_chunk_step(self, has_mask: bool, unroll: int = 1):
+        """Fused K-steps-per-dispatch SPMD program (plain sync DP only):
+        the per-step body of `_build_step` — per-shard weighted objective,
+        gradient pmean over ICI, updater — scanned over a stacked [K, B,
+        ...] chunk whose batch dim shards over the mesh's data axis.
+        Per-step RNG reproduces the per-batch path exactly:
+        fold_in(fold_in(PRNGKey(seed), iteration), axis_index).  Returns
+        per-step loss / grad-norm vectors so the host syncs once per
+        chunk.  unroll semantics as in
+        MultiLayerNetwork._make_train_chunk (1 = bit-stable rolled
+        scan)."""
+        from deeplearning4j_tpu.models.multi_layer_network import (
+            _CHUNK_UNROLL_CAP,
+        )
+
+        net = self.net
+        updater = self._updater
+        axis = self.axis
+
+        def shard_chunk(params, state, upd_state, xs, ys, ws, masks, it0,
+                        lr_scale):
+            base = jax.random.PRNGKey(net.conf.conf.seed)
+            idx = lax.axis_index(axis)
+
+            def body(carry, inp):
+                params, state, upd = carry
+                if has_mask:
+                    xi, yi, wi, mi, it = inp
+                else:
+                    (xi, yi, wi, it), mi = inp, None
+                rng = jax.random.fold_in(jax.random.fold_in(base, it), idx)
+
+                # Differentiate the UNNORMALIZED local weighted loss sum,
+                # then psum numerator/denominator/gradient separately and
+                # divide by the GLOBAL weight sum: padded tail rows may
+                # land unevenly across shards (a whole shard can be pure
+                # padding), and a pmean of per-shard weighted means would
+                # weight such shards wrongly.  This form equals the
+                # single-device weighted objective exactly.
+                def lossfn(p):
+                    num, den, new_state = net._weighted_loss_sums(
+                        p, state, xi, yi, rng, mi, wi)
+                    return num, (den, new_state)
+
+                (num, (den, new_state)), grads = jax.value_and_grad(
+                    lossfn, has_aux=True)(params)
+                denom = jnp.maximum(lax.psum(den, axis), 1.0)
+                grads = jax.tree_util.tree_map(
+                    lambda g: lax.psum(g, axis) / denom, grads)
+                loss = lax.psum(num, axis) / denom
+                if net._has_reg():
+                    # replicated term: add its gradient once, post-psum
+                    reg, reg_grads = jax.value_and_grad(net._reg_loss)(
+                        params)
+                    loss = loss + reg
+                    grads = jax.tree_util.tree_map(
+                        lambda g, r: g + r, grads, reg_grads)
+                gnorm = global_grad_norm(grads)
+                new_state = jax.tree_util.tree_map(
+                    lambda s: lax.pmean(s, axis) if jnp.issubdtype(
+                        jnp.asarray(s).dtype, jnp.floating) else s,
+                    new_state)
+                updates, upd = updater.update(grads, upd, params)
+                updates = net._apply_lr_multipliers(updates)
+                updates = jax.tree_util.tree_map(lambda u: u * lr_scale,
+                                                 updates)
+                params = apply_updates(params, updates)
+                return (params, new_state, upd), (loss, gnorm)
+
+            its = it0 + jnp.arange(xs.shape[0])
+            inputs = ((xs, ys, ws, masks, its) if has_mask
+                      else (xs, ys, ws, its))
+            (params, state, upd_state), (losses, gnorms) = lax.scan(
+                body, (params, state, upd_state), inputs,
+                unroll=min(int(xs.shape[0]), unroll, _CHUNK_UNROLL_CAP))
+            return params, state, upd_state, losses, gnorms
+
+        pspec = P()
+        cspec = P(None, self.axis)  # [K, B, ...]: shard the batch dim
+        out_specs = (pspec, pspec, pspec, pspec, pspec)
+        if has_mask:
+            fn = jax.jit(shard_map(
+                shard_chunk, mesh=self.mesh,
+                in_specs=(pspec, pspec, pspec, cspec, cspec, cspec, cspec,
+                          pspec, pspec),
+                out_specs=out_specs, check_rep=False))
+            return fn
+
+        def no_mask(params, state, upd, xs, ys, ws, it0, lr_scale):
+            return shard_chunk(params, state, upd, xs, ys, ws, None, it0,
+                               lr_scale)
+
+        fn = jax.jit(shard_map(
+            no_mask, mesh=self.mesh,
+            in_specs=(pspec, pspec, pspec, cspec, cspec, cspec, pspec,
+                      pspec),
+            out_specs=out_specs, check_rep=False))
+        return lambda p, s, u, xs, ys, ws, masks, it0, lr: fn(
+            p, s, u, xs, ys, ws, it0, lr)
+
+    def fit_chunk_async(self, xs, ys, masks=None, weights=None,
+                        unroll: int = 1):
+        """K = xs.shape[0] SPMD optimizer steps in one dispatch (fused
+        driver primitive; plain sync-DP mode only — local-SGD and
+        shard_update carry per-mode state the scan cannot thread).
+        Returns per-step (losses, grad_norms) device vectors."""
+        if self.shard_update or self.sync_every != 1:
+            raise NotImplementedError(
+                "fit_chunk_async supports the plain synchronous DP path; "
+                "use per-batch fit_batch_async for local-SGD/shard_update")
+        net = self.net
+        sh = jax.sharding.NamedSharding(self.mesh, P(None, self.axis))
+        put = lambda a: None if a is None else jax.device_put(a, sh)  # noqa: E731
+        xs = put(xs)
+        ys = put(ys)
+        masks = put(masks)
+        k = int(xs.shape[0])
+        if int(xs.shape[1]) % self.n_devices:
+            raise ValueError(
+                f"Global batch {int(xs.shape[1])} not divisible by "
+                f"{self.n_devices} devices")
+        weights = (jnp.ones(xs.shape[:2], jnp.float32) if weights is None
+                   else jnp.asarray(weights, jnp.float32))
+        weights = put(weights)
+        key = (masks is not None, max(1, int(unroll)))
+        step = self._chunk_step_fn.get(key)
+        if step is None:
+            step = self._chunk_step_fn[key] = \
+                self._build_chunk_step(key[0], key[1])
+        it0 = self._iteration
+        (net.params, net.state, net.updater_state, losses, gnorms) = step(
+            net.params, net.state, net.updater_state, xs, ys, weights,
+            masks, jnp.asarray(it0, jnp.int32),
+            jnp.asarray(net._lr_scale, jnp.float32))
+        self._iteration += k
+        net.last_grad_norm = gnorms[-1]
+        net._fire_chunk_listeners(it0, k, losses)
+        return losses, gnorms
+
+    def stage_chunk(self, chunk):
+        """Fused-driver prefetch hook: stage a HostChunk with the batch
+        dim sharded over the mesh's data axis (one sharded host->device
+        transfer on the producer thread instead of an asarray + reshard
+        on the training thread)."""
+        sh = jax.sharding.NamedSharding(self.mesh, P(None, self.axis))
+        put = lambda a: None if a is None else jax.device_put(a, sh)  # noqa: E731
+        return chunk._replace(xs=put(chunk.xs), ys=put(chunk.ys),
+                              weights=put(chunk.weights),
+                              masks=put(chunk.masks))
 
     def _build_sharded_update_step(self):
         """ZeRO-1-style cross-replica weight-update sharding (Xu et al.,
@@ -401,9 +552,10 @@ class DataParallelTrainer:
         self._iteration += 1
         if self.sync_every > 1 and self._iteration % self.sync_every == 0:
             self._average_params()
-        if net._listeners:
+        due = net._due_listeners(self._iteration)
+        if due:
             loss_f = float(loss)
-            for listener in net._listeners:
+            for listener in due:
                 listener(self._iteration, loss_f)
         return loss
 
@@ -411,7 +563,26 @@ class DataParallelTrainer:
         """fit_batch_async + host sync on the loss."""
         return float(self.fit_batch_async(x, y, mask))
 
-    def fit(self, data, epochs: int = 1) -> "DataParallelTrainer":
+    def fit(self, data, epochs: int = 1,
+            chunk_size: "int | None" = None,
+            prefetch: int = 2, chunk_unroll: int = 1
+            ) -> "DataParallelTrainer":
+        """`chunk_size` routes the loop through the fused multi-step
+        driver (runtime/fused.py): K SPMD steps per dispatch, chunks
+        device-staged pre-sharded on a background thread.  Padding keeps
+        tail batches at the group batch size, so ragged tails that the
+        per-batch path rejects (batch % devices != 0) train fine chunked.
+        Plain sync mode only; local-SGD / shard_update fall back to the
+        per-batch loop."""
+        if (chunk_size is not None and not self.shard_update
+                and self.sync_every == 1):
+            from deeplearning4j_tpu.runtime.fused import FusedTrainingDriver
+
+            FusedTrainingDriver(self, chunk_size=chunk_size,
+                                prefetch=prefetch,
+                                unroll=chunk_unroll).fit(data, epochs=epochs)
+            self.finalize()
+            return self
         for _ in range(epochs):
             for x, y, mask in _as_batches(data):
                 self.fit_batch(x, y, mask)

@@ -62,6 +62,14 @@ class ResilienceConfig:
     keep: int = 3                       # keep-last-K retention
     keep_best: bool = True              # never GC the best-scoring ckpt
     save_updater: bool = True
+    # fused multi-step dispatch: buffer this many (finiteness-checked)
+    # batches and run them as ONE fused chunk (runner.fit_chunk_async),
+    # syncing the per-step loss/grad-norm vectors to the host once per
+    # chunk instead of once per step.  Divergence/NaN handling keeps
+    # per-step granularity: a fault inside a chunk restores the pre-chunk
+    # snapshot and replays that chunk at chunk_size=1.  1 = per-step
+    # supervision (the legacy path).
+    chunk_size: int = 1
     # poison batches
     check_batches: bool = True          # host-side isfinite() on x/y
     skip_budget: int = 5                # max poison batches skipped per run
@@ -310,20 +318,30 @@ class TrainingSupervisor:
         self.batches_consumed += 1
         if (self.config.check_batches
                 and not self._batch_is_finite(x, y, mask)):
-            self.skipped += 1
-            report = FaultReport(
-                kind=NAN_BATCH, step=self.step, action="skip",
-                detail=f"non-finite values in input batch "
-                       f"({self.skipped}/{self.config.skip_budget} skips)")
-            self.faults.append(report)
-            if self.skipped > self.config.skip_budget:
-                report.action = "abort"
-                raise SupervisorAbort(
-                    f"poison-batch skip budget exhausted "
-                    f"({self.config.skip_budget}): {report}", report=report)
-            log.warning("skipping poison batch: %s", report)
+            self._poison_skip()
             return None
+        return self._execute_step(x, y, mask)
 
+    def _poison_skip(self) -> None:
+        """Bookkeeping for one skipped poison batch (shared by the
+        per-step and chunked loops); raises on budget exhaustion."""
+        self.skipped += 1
+        report = FaultReport(
+            kind=NAN_BATCH, step=self.step, action="skip",
+            detail=f"non-finite values in input batch "
+                   f"({self.skipped}/{self.config.skip_budget} skips)")
+        self.faults.append(report)
+        if self.skipped > self.config.skip_budget:
+            report.action = "abort"
+            raise SupervisorAbort(
+                f"poison-batch skip budget exhausted "
+                f"({self.config.skip_budget}): {report}", report=report)
+        log.warning("skipping poison batch: %s", report)
+
+    def _execute_step(self, x, y, mask=None) -> Optional[float]:
+        """The guarded train+health part of one step: no preemption or
+        finiteness checks, no batch accounting — the chunk replay path
+        re-enters here for batches that were already consumed/checked."""
         from deeplearning4j_tpu.optimize.api import InvalidScoreError
 
         try:
@@ -363,10 +381,190 @@ class TrainingSupervisor:
         g = getattr(self.net, "last_grad_norm", None)
         return None if g is None else float(g)
 
+    # ---- fused-chunk supervision -------------------------------------------
+
+    def _supports_chunks(self) -> bool:
+        """A runner takes the fused-chunk path only when its
+        `fit_chunk_async` actually works: DataParallelTrainer exposes the
+        method in every mode but raises for local-SGD/shard_update."""
+        return (hasattr(self.runner, "fit_chunk_async")
+                and not getattr(self.runner, "shard_update", False)
+                and getattr(self.runner, "sync_every", 1) == 1)
+
+    def _snapshot_train_state(self):
+        """In-memory COPIES of (params, updater_state, layer state) — the
+        pre-chunk rollback anchor.  Copies are required, not references:
+        the chunk step donates its input buffers, so the originals are
+        invalidated the moment the chunk dispatches."""
+        import jax
+        import jax.numpy as jnp
+
+        publish = getattr(self.runner, "publish_train_state", None)
+        if callable(publish):
+            publish()
+
+        def copy(tree):
+            return (None if tree is None else jax.tree_util.tree_map(
+                lambda a: jnp.array(a, copy=True), tree))
+
+        return (copy(self.net.params), copy(self.net.updater_state),
+                copy(getattr(self.net, "state", None)))
+
+    def _restore_snapshot(self, step: int, snapshot) -> None:
+        params, upd, net_state = snapshot
+        self.runner.restore_train_state(
+            step, params, self._moments_or_fresh(upd, params), net_state)
+        self.step = step
+
+    def _supervise_chunk(self, batches) -> None:
+        """Dispatch `batches` (already finiteness-checked) as ONE fused
+        chunk, then feed the per-step loss/grad-norm vectors — one host
+        sync total — through the health monitor at per-step granularity.
+        Any flagged step restores the pre-chunk snapshot and replays the
+        whole chunk at chunk_size=1 through `_execute_step`, where the
+        normal rollback/backoff machinery handles the faulty step."""
+        import copy as copy_mod
+
+        from deeplearning4j_tpu.optimize.api import InvalidScoreError
+        from deeplearning4j_tpu.runtime.fused import stack_batches
+
+        if len(batches) == 1:
+            self._execute_step(*batches[0])
+            return
+        snap_step = self.step
+        snapshot = self._snapshot_train_state()
+        health0 = copy_mod.deepcopy(self.health)
+        chunk = stack_batches(batches)
+        fault: Optional[FaultReport] = None
+
+        def dispatch_and_sync():
+            # The host sync happens INSIDE the (optional) watchdog
+            # window: the async dispatch returns in microseconds even
+            # when the device is wedged — it is the materialization of
+            # the loss vector that would hang.
+            ls, gs = self.runner.fit_chunk_async(
+                chunk.xs, chunk.ys, chunk.masks, chunk.weights)
+            return np.asarray(ls), np.asarray(gs)
+
+        try:
+            if self.watchdog is not None:
+                # one watchdog window bounds the whole chunk: K steps of
+                # budget, since the fused dispatch IS K steps
+                wd = StepWatchdog(self.config.step_timeout * len(batches))
+                losses, gnorms = wd.run(dispatch_and_sync, step=self.step)
+            else:
+                losses, gnorms = dispatch_and_sync()
+        except InvalidScoreError as e:
+            fault = FaultReport(
+                kind=NONFINITE_LOSS, step=self.step, score=e.score,
+                detail="typed score guard fired inside a fused chunk",
+                exception=repr(e))
+        except StepTimeoutError as e:
+            if e.report is not None:
+                self.faults.append(e.report)
+            raise
+        if fault is None:
+            for i in range(len(batches)):
+                action, report = self.health.observe(
+                    snap_step + i, float(losses[i]), float(gnorms[i]))
+                if action is HealthAction.ROLLBACK:
+                    fault = report
+                    break
+            else:
+                self.step = int(getattr(self.runner, "_iteration",
+                                        snap_step + len(batches)))
+                self.last_loss = float(losses[-1])
+                every = max(1, self.config.checkpoint_every)
+                if (self.step // every > snap_step // every
+                        and not self.health.suspect):
+                    self.checkpoint(score=self.last_loss)
+                return
+        # A step inside the chunk misbehaved: rewind state AND health to
+        # the chunk boundary (its observations are discarded with it),
+        # then replay per-batch so rollback granularity stays one step.
+        self.faults.append(FaultReport(
+            kind=fault.kind, step=fault.step, action="replay",
+            detail=f"fused chunk of {len(batches)} replayed at "
+                   f"chunk_size=1 after {fault.kind} at step {fault.step}"))
+        self.health = health0
+        self._restore_snapshot(snap_step, snapshot)
+        for x, y, mask in batches:
+            self._execute_step(x, y, mask)
+
+    def _run_chunked(self, data, chunk_size: int,
+                     max_steps: Optional[int]) -> RunReport:
+        """The chunked supervised loop: fetch (with retry) and
+        finiteness-check batches one at a time, buffer the good ones, and
+        flush every `chunk_size` as one fused dispatch.  Preemption is
+        honored at chunk boundaries — already-fetched batches are trained
+        before the emergency checkpoint so `batches_consumed` stays equal
+        to trained + skipped and resume's fast-forward replays nothing
+        and loses nothing."""
+        if not self._has_checkpoint():
+            self.checkpoint(score=None)  # rollback anchor before step 1
+        it = iter(data)
+        batches_seen = 0
+        preempted = False
+        pending: list = []
+        pending_key = None
+
+        def flush():
+            if pending:
+                self._supervise_chunk(pending)
+                pending.clear()
+
+        def batch_key(x, y, mask):
+            # same grouping rule as fused.assemble_chunks: stacked
+            # batches must agree on feature/label shapes and mask
+            # presence (a buffer mixing them would mis-stack or silently
+            # drop masks)
+            return (np.shape(x)[1:], np.shape(y)[1:],
+                    None if mask is None else np.shape(mask)[1:])
+
+        while max_steps is None or self.step < max_steps:
+            if self._preempt.is_set():
+                flush()
+                self._maybe_preempt()   # emergency checkpoint + report
+                preempted = True
+                break
+            try:
+                item = self._fetch(it)
+            except StopIteration:
+                break
+            except SimulatedPreemption:
+                self.request_preemption()
+                continue
+            batches_seen += 1
+            x, y, mask = _normalize(item)
+            self.batches_consumed += 1
+            if (self.config.check_batches
+                    and not self._batch_is_finite(x, y, mask)):
+                self._poison_skip()
+                continue
+            key = batch_key(x, y, mask)
+            if pending and key != pending_key:
+                flush()   # shape/mask-presence change: new chunk group
+            pending_key = key
+            pending.append((x, y, mask))
+            cap = (chunk_size if max_steps is None
+                   else min(chunk_size, max_steps - self.step))
+            if len(pending) >= cap:
+                flush()
+        if not preempted:
+            flush()
+        if (not preempted and self.last_loss is not None
+                and not self.health.suspect):
+            self.checkpoint(score=self.last_loss)
+        return RunReport(
+            steps=self.step, batches_seen=batches_seen,
+            skipped=self.skipped, rollbacks=self.rollbacks,
+            preempted=preempted, final_loss=self.last_loss,
+            lr_scale=float(self.net._lr_scale), faults=list(self.faults))
+
     # ---- the supervised loop ----------------------------------------------
 
-    def run(self, data: Iterable, *, max_steps: Optional[int] = None
-            ) -> RunReport:
+    def run(self, data: Iterable, *, max_steps: Optional[int] = None,
+            chunk_size: Optional[int] = None) -> RunReport:
         """Drive the runner over ``data`` (an iterable of (x, y[, mask])
         tuples or DataSet-like objects) under the full policy set.
 
@@ -378,7 +576,23 @@ class TrainingSupervisor:
         SIGTERM.  Returns a `RunReport`; a preempted run returns (rather
         than raises) with ``preempted=True`` so callers can checkpoint
         logs and exit cleanly.
+
+        ``chunk_size`` (default ``config.chunk_size``) > 1 dispatches the
+        run in fused multi-step chunks — one host sync per chunk, health
+        checks on the per-step loss/grad-norm vectors, faults replayed at
+        per-step granularity (see ``_run_chunked``); requires a runner
+        with ``fit_chunk_async`` (`MultiLayerNetwork`, plain-sync
+        `DataParallelTrainer`).
         """
+        k = chunk_size if chunk_size is not None else self.config.chunk_size
+        if k > 1 and self._supports_chunks():
+            return self._run_chunked(data, int(k), max_steps)
+        if k > 1:
+            log.warning(
+                "chunk_size=%s requested but %s has no fused chunk path "
+                "(local-SGD / shard_update trainers carry per-mode state "
+                "the scan cannot thread); supervising per-step", k,
+                type(self.runner).__name__)
         if not self._has_checkpoint():
             self.checkpoint(score=None)  # rollback anchor before step 1
         it = iter(data)

@@ -21,6 +21,11 @@ from deeplearning4j_tpu.runtime.checkpoint import (
     save_model,
     save_params,
 )
+from deeplearning4j_tpu.runtime.fused import (
+    FusedTrainingDriver,
+    HostChunk,
+    assemble_chunks,
+)
 from deeplearning4j_tpu.runtime.determinism import (
     NondeterminismError,
     check_network_determinism,
@@ -36,6 +41,9 @@ from deeplearning4j_tpu.runtime.storage import (
 )
 
 __all__ = [
+    "FusedTrainingDriver",
+    "HostChunk",
+    "assemble_chunks",
     "save_model",
     "load_model",
     "save_params",

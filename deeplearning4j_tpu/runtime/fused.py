@@ -1,0 +1,203 @@
+"""Fused multi-step training driver: K optimizer steps per XLA dispatch.
+
+The reference (and the bare `fit` loops here before this module) paid the
+full host round-trip on every minibatch: convert the batch, dispatch one
+jitted step, and — whenever anything wanted the loss — sync.  On a fast
+chip the step outruns the host and the device idles between dispatches.
+The standard JAX remedy is step fusion: stack K batches on device and run
+K optimizer steps inside ONE jitted `lax.scan`, returning the per-step
+losses and gradient norms as device vectors so at most one host sync
+happens per chunk (docs/performance.md#the-dispatch-overhead-model).
+
+Three cooperating pieces live here:
+
+- :func:`assemble_chunks` — host-side chunk assembly.  Groups an
+  (x, y, mask) batch stream into `[K, B, ...]` stacks; a ragged tail
+  batch is PADDED to the group's batch size with zero rows and zero
+  example weights instead of changing shape, so the whole epoch (and
+  every later epoch) runs through exactly two compiled programs per batch
+  shape: the `[K, ...]` chunk and the `[1, ...]` remainder.
+- :class:`FusedTrainingDriver` — the loop shared by
+  `MultiLayerNetwork.fit(chunk_size=...)` and
+  `DataParallelTrainer.fit(chunk_size=...)`.  It pipelines three stages:
+  the assembler (host), a device-prefetch stage layered on
+  `PrefetchDataSetIterator` that stacks + `device_put`s chunk i+1 (with
+  the runner's sharding — `NamedSharding` over the data axis in the
+  data-parallel case) while chunk i computes, and the runner's
+  `fit_chunk_async` dispatch.
+- the runner protocol — any object with ``fit_chunk_async(xs, ys, masks,
+  weights) -> (losses, grad_norms)`` and ``stage_chunk(chunk)``;
+  `MultiLayerNetwork` and the plain-sync `DataParallelTrainer` implement
+  it.
+
+Chunk-size invariance: every step inside a chunk runs the SAME
+example-weighted objective with the same per-iteration RNG fold-in, so
+`chunk_size=1` and `chunk_size=K` execute identical per-step programs
+over identical data — bitwise-identical parameters on CPU
+(tests/test_fused_driver.py).  The resilience supervisor exploits that:
+a fault inside a chunk restores the pre-chunk snapshot and replays the
+same batches at `chunk_size=1` (resilience/supervisor.py).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, NamedTuple, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+class HostChunk(NamedTuple):
+    """One assembled chunk: `steps` stacked batches (leading dim = steps
+    per dispatch).  `weights[k, b] == 0` marks a padded tail row that
+    must contribute nothing to step k's update."""
+
+    xs: np.ndarray                    # [K, B, ...features]
+    ys: np.ndarray                    # [K, B, ...labels]
+    weights: np.ndarray               # [K, B] float32 example weights
+    masks: Optional[np.ndarray]       # [K, B, T] or None
+
+    @property
+    def steps(self) -> int:
+        return int(self.xs.shape[0])
+
+
+def _stack(items, pad_b: int) -> HostChunk:
+    x0, y0, m0 = items[0]
+    k = len(items)
+    xs = np.zeros((k, pad_b) + x0.shape[1:], x0.dtype)
+    ys = np.zeros((k, pad_b) + y0.shape[1:], y0.dtype)
+    ws = np.zeros((k, pad_b), np.float32)
+    ms = (None if m0 is None
+          else np.zeros((k, pad_b) + m0.shape[1:], np.float32))
+    for i, (x, y, m) in enumerate(items):
+        n = x.shape[0]
+        xs[i, :n] = x
+        ys[i, :n] = y
+        ws[i, :n] = 1.0
+        if ms is not None:
+            ms[i, :n] = m
+    return HostChunk(xs, ys, ws, ms)
+
+
+def stack_batches(batches) -> HostChunk:
+    """Stack a list of same-shape (x, y, mask) batches into one
+    HostChunk, padding ragged batches to the largest batch size (the
+    supervisor's entry point for an already-buffered chunk)."""
+    norm = [(np.asarray(x), np.asarray(y),
+             None if m is None else np.asarray(m)) for x, y, m in batches]
+    return _stack(norm, max(x.shape[0] for x, _, _ in norm))
+
+
+def assemble_chunks(batches: Iterable[Tuple], chunk_size: int
+                    ) -> Iterable[HostChunk]:
+    """Group an (x, y, mask) stream into :class:`HostChunk`s.
+
+    - The first batch of a group fixes the group's batch size; smaller
+      (tail) batches are padded to it with zero rows + zero weights.
+    - A feature-shape change, mask-presence change, or LARGER batch
+      flushes the open group and starts a new one (new jit cache key).
+    - A group holding fewer than `chunk_size` batches (end of stream,
+      shape flush) is emitted as length-1 chunks so the only compiled
+      programs per shape are `[chunk_size, ...]` and `[1, ...]` — the
+      compile count stays constant no matter how epochs divide.
+    """
+    if chunk_size < 1:
+        raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+    buf: list = []
+    key = None
+    pad_b = 0
+
+    def flush():
+        out = []
+        if len(buf) == chunk_size:
+            out.append(_stack(buf, pad_b))
+        else:
+            out.extend(_stack([b], pad_b) for b in buf)
+        buf.clear()
+        return out
+
+    for batch in batches:
+        if isinstance(batch, tuple):
+            x, y, m = (batch + (None,))[:3]
+        else:  # DataSet-like
+            x, y, m = (batch.features, batch.labels,
+                       getattr(batch, "mask", None))
+        x = np.asarray(x)
+        y = np.asarray(y)
+        m = None if m is None else np.asarray(m)
+        k = (x.shape[1:], y.shape[1:], None if m is None else m.shape[1:])
+        if key is None:
+            key, pad_b = k, x.shape[0]
+        if k != key or x.shape[0] > pad_b:
+            yield from flush()
+            key, pad_b = k, x.shape[0]
+        buf.append((x, y, m))
+        if len(buf) == chunk_size:
+            yield from flush()
+    yield from flush()
+
+
+class FusedTrainingDriver:
+    """Drives a runner's `fit_chunk_async` over a batch stream.
+
+    `prefetch > 0` stages the next chunk (stack + device_put with the
+    runner's sharding) on a background thread while the current chunk
+    computes — the host pipeline never blocks the device between chunks.
+
+    `unroll=1` (default) keeps the chunk scan rolled: one compiled step
+    body for every trip count, hence bitwise chunk-size-invariant
+    training.  `unroll>1` unrolls the scan so XLA can fuse across steps —
+    faster, but the fusion perturbs low-order bits, so different chunk
+    sizes then agree only to float tolerance.
+    """
+
+    def __init__(self, runner, chunk_size: int = 8, prefetch: int = 2,
+                 unroll: int = 1):
+        if chunk_size < 1:
+            raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+        self.runner = runner
+        self.chunk_size = int(chunk_size)
+        self.prefetch = int(prefetch)
+        self.unroll = max(1, int(unroll))
+
+    def _stream(self, data, epochs: int):
+        from deeplearning4j_tpu.models.multi_layer_network import (
+            _as_batches,
+            _maybe_reset,
+        )
+
+        for _ in range(epochs):
+            for batch in _as_batches(data):
+                yield batch
+            _maybe_reset(data)
+
+    def fit(self, data, epochs: int = 1):
+        """Train over `data` (same accepted forms as
+        `MultiLayerNetwork.fit`) with K steps per dispatch."""
+        import types
+
+        if isinstance(data, types.GeneratorType) and epochs != 1:
+            raise ValueError(
+                "one-shot generators cannot replay across epochs; "
+                "materialize the batches or pass an iterator with reset()")
+        chunks = assemble_chunks(self._stream(data, epochs),
+                                 self.chunk_size)
+        if self.prefetch > 0:
+            from deeplearning4j_tpu.datasets.iterators import (
+                PrefetchDataSetIterator,
+            )
+
+            staged = PrefetchDataSetIterator(
+                chunks, depth=self.prefetch,
+                transform=self.runner.stage_chunk)
+        else:
+            staged = (self.runner.stage_chunk(c) for c in chunks)
+        last = None
+        for chunk in staged:
+            last = self.runner.fit_chunk_async(
+                chunk.xs, chunk.ys, chunk.masks, chunk.weights,
+                unroll=self.unroll)
+        if last is not None:
+            jax.block_until_ready(last[0])
+        return self.runner

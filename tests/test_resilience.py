@@ -58,9 +58,13 @@ def _cfg(tmp_path, **overrides):
 
 
 class TestAcceptance:
-    def test_chaos_run_finishes_and_matches_clean_run(self, tmp_path):
+    @pytest.mark.parametrize("chunk_size", [1, 4])
+    def test_chaos_run_finishes_and_matches_clean_run(self, tmp_path,
+                                                      chunk_size):
         """NaN batches + fetch failure + simulated preemption: training
-        finishes and final loss is within 10% of the uninjected run."""
+        finishes and final loss is within 10% of the uninjected run.
+        Runs both per-step (chunk_size=1) and through the fused
+        multi-step dispatch path (chunk_size=4, ISSUE 2)."""
         x, y = _data()
         clean_batches = _epoch_batches(x, y) * 15  # 120 updates
 
@@ -78,7 +82,8 @@ class TestAcceptance:
             nan_steps=(5, 30), fetch_fail_steps=(9,), preempt_at=61))
 
         net_b = MultiLayerNetwork(iris_mlp()).init()
-        report1 = TrainingSupervisor(net_b, _cfg(tmp_path)).run(source)
+        report1 = TrainingSupervisor(
+            net_b, _cfg(tmp_path, chunk_size=chunk_size)).run(source)
         assert report1.preempted
         assert report1.skipped == 2          # both NaN records skipped
         assert any(f.kind == "fetch_error" and f.action == "retry"
@@ -87,7 +92,8 @@ class TestAcceptance:
         # "process restart": fresh net, resume from the emergency
         # checkpoint, continue from the SAME source (position survives)
         net_c = MultiLayerNetwork(iris_mlp()).init()
-        sup2 = TrainingSupervisor(net_c, _cfg(tmp_path))
+        sup2 = TrainingSupervisor(net_c,
+                                  _cfg(tmp_path, chunk_size=chunk_size))
         assert sup2.resume()
         assert sup2.step == report1.steps
         report2 = sup2.run(source)

@@ -1,10 +1,14 @@
 # Convenience entry points. Everything here is reproducible by hand —
 # the targets just spell the one-liners out.
 
-.PHONY: test dryrun bench smoke evidence lint
+.PHONY: test test-serving dryrun bench smoke serving-smoke evidence lint
 
 test:
 	python -m pytest tests/ -x -q
+
+# Serving subsystem only (micro-batcher, bucket ladder, continuous LM).
+test-serving:
+	python -m pytest tests/ -q -m serving
 
 # Broad-except linter (see docs/robustness.md): fails on new bare
 # `except Exception:` in deeplearning4j_tpu/ without a noqa pragma.
@@ -21,6 +25,10 @@ bench:
 
 smoke:
 	BENCH_ONLY=lenet,transformer python bench.py
+
+# Serving throughput rows only (micro-batched classifier + continuous LM).
+serving-smoke:
+	BENCH_ONLY=serving,servinglm python bench.py
 
 # Regenerate every committed EVIDENCE/ artifact (see EVIDENCE/README.md).
 # Each runner re-execs itself into a scrubbed 8-virtual-CPU-device env,

@@ -342,6 +342,78 @@ def _lm_mesh_train(args, cfg, ids, B, S):
     return trainer.export_params()
 
 
+def _load_saved_lm(out: pathlib.Path):
+    """Load an LM saved by `dl4j lm` (lm_config.json + lm_params.npz)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel import transformer as tfm
+    from deeplearning4j_tpu.runtime.checkpoint import npz_to_tree
+
+    cfg_path, params_path = out / "lm_config.json", out / "lm_params.npz"
+    if not cfg_path.exists():
+        raise SystemExit(f"no saved LM at {out}")
+    if not params_path.exists():
+        raise SystemExit(f"saved LM incomplete: {params_path} missing")
+    cfg = tfm.TransformerConfig(**json.loads(cfg_path.read_text()))
+    params = npz_to_tree(params_path,
+                         tfm.init_params(cfg, jax.random.PRNGKey(0)))
+    return cfg, jax.tree_util.tree_map(jnp.asarray, params)
+
+
+def cmd_serve(args) -> int:
+    """Serve a saved model and/or LM over HTTP with dynamic
+    micro-batching, shape-bucketed compilation and continuous LM decode
+    (deeplearning4j_tpu/serving/; cost model in docs/performance.md)."""
+    from deeplearning4j_tpu.serving import BucketLadder
+    from deeplearning4j_tpu.ui.server import UiServer
+
+    if not args.model and not args.lm:
+        raise SystemExit("serve needs -model and/or -lm")
+    srv = UiServer(host=args.host, port=args.port)
+    if args.model:
+        net = _build_net(args.model)
+        ladder = BucketLadder(tuple(
+            int(b) for b in args.buckets.split(",")))
+        srv.serve_model(net,
+                        max_batch=min(args.max_batch, ladder.max_batch),
+                        max_wait_ms=args.max_wait_ms, ladder=ladder)
+        from deeplearning4j_tpu.nn.conf import DenseLayerConf
+
+        first = net.conf.layers[0]
+        # n_in is a FLAT feature width only for dense stacks; for conv /
+        # RNN first layers it means channels / per-step features, so a
+        # [b, n_in] warmup batch would crash the forward at startup
+        flat = isinstance(first, DenseLayerConf) and first.n_in
+        if args.warmup and flat:
+            warmed = srv.state.engine.warmup(
+                np.zeros((int(first.n_in),), np.float32))
+            print(f"serve: pre-compiled {warmed} bucket shapes")
+        elif args.warmup:
+            print("serve: -warmup skipped (non-flat input layer "
+                  f"{type(first).__name__}); the first request per "
+                  "bucket compiles instead")
+    if args.lm:
+        cfg, params = _load_saved_lm(pathlib.Path(args.lm))
+        srv.serve_lm(cfg, params, slots=args.lm_slots)
+        print(f"serve: LM registered ({cfg.n_layers}L/d{cfg.d_model}, "
+              f"max_len {cfg.max_len}, {args.lm_slots} decode slots)")
+    srv.start()
+    print(f"Serving on {srv.url} — POST /model/predict, /lm/generate; "
+          f"GET /serving/stats")
+    try:
+        if args.serve_seconds > 0:
+            time.sleep(args.serve_seconds)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
 def cmd_lm(args) -> int:
     """Train the flagship TransformerLM on a raw text file (byte-level
     vocab, causal LM) and/or generate from a saved one — the CLI surface
@@ -366,12 +438,7 @@ def cmd_lm(args) -> int:
         tree_to_npz(params_path, params)  # atomic write
 
     def load():
-        if not params_path.exists():
-            raise SystemExit(f"saved LM incomplete: {params_path} missing")
-        cfg = tfm.TransformerConfig(**json.loads(cfg_path.read_text()))
-        params = npz_to_tree(params_path,
-                             tfm.init_params(cfg, jax.random.PRNGKey(0)))
-        return cfg, jax.tree_util.tree_map(jnp.asarray, params)
+        return _load_saved_lm(out)
 
     if args.input:
         text = pathlib.Path(args.input).read_bytes()
@@ -717,6 +784,41 @@ def build_parser() -> argparse.ArgumentParser:
                            "stages")
     p_lm.add_argument("-verbose", "--verbose", action="store_true")
     p_lm.set_defaults(fn=cmd_lm)
+
+    p_serve = sub.add_parser(
+        "serve", help="serve a saved model/LM over HTTP with dynamic "
+                      "micro-batching")
+    p_serve.add_argument("-model", "--model", default=None,
+                         help="saved model dir, conf JSON, or zoo:<name> "
+                              "for POST /model/predict")
+    p_serve.add_argument("-lm", "--lm", default=None,
+                         help="saved LM dir (from `dl4j lm`) for "
+                              "POST /lm/generate")
+    p_serve.add_argument("-host", "--host", default="127.0.0.1")
+    p_serve.add_argument("-port", "--port", type=int, default=8080,
+                         help="0 picks a free port")
+    p_serve.add_argument("-max-batch", "--max-batch", dest="max_batch",
+                         type=int, default=32,
+                         help="most rows one coalesced dispatch carries")
+    p_serve.add_argument("-max-wait-ms", "--max-wait-ms",
+                         dest="max_wait_ms", type=float, default=2.0,
+                         help="how long the micro-batcher holds a request "
+                              "open for co-travellers")
+    p_serve.add_argument("-buckets", "--buckets", default="1,8,32",
+                         help="comma-separated batch bucket ladder; every "
+                              "dispatch pads up to the next bucket so the "
+                              "compiled-program set stays bounded")
+    p_serve.add_argument("-warmup", "--warmup", action="store_true",
+                         help="pre-compile every bucket shape before "
+                              "accepting traffic")
+    p_serve.add_argument("-lm-slots", "--lm-slots", dest="lm_slots",
+                         type=int, default=4,
+                         help="continuous-decode lanes for /lm/generate")
+    p_serve.add_argument("-serve-seconds", "--serve-seconds",
+                         dest="serve_seconds", type=float, default=0,
+                         help="stop after this many seconds (0 = run "
+                              "until interrupted)")
+    p_serve.set_defaults(fn=cmd_serve)
 
     p_test = sub.add_parser("test", help="evaluate a saved model")
     common(p_test)

@@ -11,10 +11,11 @@ from deeplearning4j_tpu.models.zoo import (
     iris_mlp,
     lenet_digits,
     lenet_mnist,
+    mnist_mlp,
 )
 
 __all__ = [
     "MultiLayerNetwork", "RNTN", "RNTNEval", "RecursiveAutoEncoder",
     "ZOO", "get_model", "lenet_mnist", "lenet_digits", "alexnet_cifar10",
-    "char_lstm", "iris_mlp",
+    "char_lstm", "iris_mlp", "mnist_mlp",
 ]

@@ -837,6 +837,38 @@ class MultiLayerNetwork:
                     p, s, x, train=False, mask=mask)[0])
         return self._jit_forward(self.params, self.state, jnp.asarray(x), mask)
 
+    def output_bucketed(self, x, mask=None, ladder=None) -> np.ndarray:
+        """`output` through a serving bucket ladder: the batch is padded
+        UP to the ladder's next bucket before dispatch and the padding
+        rows sliced off the result — so a mixed-batch-size request
+        stream reuses the ONE cached jitted forward per bucket shape
+        instead of compiling a program per distinct batch size.
+        Inference rows are independent (no batch statistics), so padded
+        and unpadded dispatches produce bitwise-identical real rows
+        (pinned by tests/test_serving.py).  Returns a HOST array: the
+        row slice happens after the one device->host transfer, because a
+        device-side `out[:n]` would compile a (tiny) XLA slice program
+        per distinct n — exactly the unbounded-compile leak the ladder
+        exists to prevent."""
+        from deeplearning4j_tpu.serving.bucketing import BucketLadder
+
+        if ladder is None:
+            ladder = BucketLadder()
+        x = np.asarray(x)
+        padded, n = ladder.pad_rows(x)
+        if mask is not None:
+            mask, _ = ladder.pad_rows(np.asarray(mask))
+            mask = jnp.asarray(mask)
+        out = np.asarray(self.output(padded, mask))
+        return out if n == padded.shape[0] else out[:n]
+
+    def forward_program_count(self) -> int:
+        """Number of XLA programs compiled for the cached inference
+        forward — the serving compile-count guard's observable."""
+        if self._jit_forward is None:
+            return 0
+        return int(self._jit_forward._cache_size())
+
     def feed_forward(self, x, mask=None) -> List[jax.Array]:
         """All per-layer activations (reference feedForward() :542)."""
         acts, _ = self._forward(self.params, self.state, jnp.asarray(x),
@@ -872,7 +904,11 @@ class MultiLayerNetwork:
         Batched eval fast path: the dataset is staged on device ONCE,
         mini-batches are device-resident slices through the single cached
         jitted forward, and the predictions come back to the host in ONE
-        transfer at the end — no per-mini-batch asarray round-trips."""
+        transfer at the end — no per-mini-batch asarray round-trips.
+        A ragged final slice is padded to `batch_size` with zero rows
+        (masked out of the metrics by slicing them off the output), so
+        the whole evaluation runs ONE compiled program instead of
+        compiling a second tail-shape program per dataset size."""
         from deeplearning4j_tpu.evaluation import Evaluation
 
         ev = Evaluation()
@@ -883,11 +919,22 @@ class MultiLayerNetwork:
             return ev
         xd = jnp.asarray(x)                    # one host->device transfer
         md = None if mask is None else jnp.asarray(mask)
+        n = int(xd.shape[0])
         outs = []
-        for i in range(0, int(xd.shape[0]), batch_size):
+        for i in range(0, n, batch_size):
+            xb = xd[i:i + batch_size]
             m = None if md is None else md[i:i + batch_size]
-            outs.append(self.output(xd[i:i + batch_size], m))
-        out = np.asarray(jnp.concatenate(outs, axis=0))  # one device->host
+            if int(xb.shape[0]) < batch_size:  # padded tail, same program
+                pad = batch_size - int(xb.shape[0])
+                xb = jnp.concatenate(
+                    [xb, jnp.zeros((pad,) + xb.shape[1:], xb.dtype)])
+                if m is not None:
+                    m = jnp.concatenate(
+                        [m, jnp.zeros((pad,) + m.shape[1:], m.dtype)])
+            outs.append(self.output(xb, m))
+        # one device->host transfer; the tail's padding rows (device-
+        # slicing them would compile an extra program) drop off here
+        out = np.asarray(jnp.concatenate(outs, axis=0))[:n]
         ev.eval(np.asarray(y), out)
         return ev
 

@@ -202,8 +202,25 @@ def iris_mlp(updater: str = "adam", learning_rate: float = 0.02,
     )
 
 
+def mnist_mlp(updater: str = "adam", learning_rate: float = 0.01,
+              seed: int = 5, width: int = 2048) -> MultiLayerConfiguration:
+    """MNIST-class wide MLP classifier (784-width-width-10) — the serving
+    benchmark's model (bench.py bench_serving): wide enough that a
+    single-request forward is weight-bandwidth-bound, which is exactly
+    the regime where micro-batched serving wins (one pass over the
+    weights serves the whole coalesced batch)."""
+    return MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=learning_rate,
+                                    updater=updater, seed=seed),
+        layers=(DenseLayerConf(n_in=784, n_out=width, activation="relu"),
+                DenseLayerConf(n_in=width, n_out=width, activation="relu"),
+                OutputLayerConf(n_in=width, n_out=10)),
+    )
+
+
 ZOO = {
     "lenet-mnist": lenet_mnist,
+    "mnist-mlp": mnist_mlp,
     "lenet-digits": lenet_digits,
     "alexnet-cifar10": alexnet_cifar10,
     "char-lstm": char_lstm,

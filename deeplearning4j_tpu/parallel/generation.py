@@ -197,6 +197,112 @@ def generate(cfg: TransformerConfig, params: dict, prompt,
 
 
 # ---------------------------------------------------------------------------
+# Slot-based decode (continuous batching for the serving engine)
+#
+# `generate()` above runs ONE request (or one fixed batch) to completion:
+# every row shares a single scalar position.  A serving process wants the
+# opposite shape: a fixed pool of B_slots decode lanes over one
+# [L, B_slots, max_len, H, K] KV cache, where each slot sits at its OWN
+# position — finished sequences free their slot and queued prompts join
+# mid-flight (prefill rides the same per-token step, teacher-forced).
+# The step below is that primitive; serving/lm.py drives the loop.
+
+
+def _slot_attn(p, x, layer_k, layer_v, pos):
+    """Per-slot single-position attention: like `_cached_attn` but `pos`
+    is a [B] vector — each row writes its k/v at its own position
+    (vmapped `lax.dynamic_update_slice`) and masks its own history."""
+    q, k, v = qkv_proj(p, x)                              # [B, 1, H, K]
+
+    def write(buf, new, p_):                              # one slot's row
+        return lax.dynamic_update_slice(buf, new, (p_, 0, 0))
+
+    layer_k = jax.vmap(write)(layer_k, k, pos)
+    layer_v = jax.vmap(write)(layer_v, v, pos)
+    d = q.shape[-1]
+    s = jnp.einsum("bqhk,bshk->bqhs", q, layer_k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    valid = jnp.arange(layer_k.shape[1])[None, :] <= pos[:, None]  # [B, S]
+    s = jnp.where(valid[:, None, None, :], s, -1e30)
+    w = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bqhs,bshk->bqhk", w, layer_v)
+    return out_proj(p, o), layer_k, layer_v
+
+
+def init_slot_cache(cfg: TransformerConfig, slots: int) -> dict:
+    """Slot KV cache: `init_cache` with a [B] per-slot position vector."""
+    dt = jnp.dtype(cfg.dtype)
+    shape = (slots, cfg.max_len, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros((cfg.n_layers,) + shape, dt),
+            "v": jnp.zeros((cfg.n_layers,) + shape, dt),
+            "pos": jnp.zeros((slots,), jnp.int32)}
+
+
+def slot_decode_step(cfg: TransformerConfig, params: dict, cache: dict,
+                     token: jax.Array) -> Tuple[jax.Array, dict]:
+    """token: [B] int32, row b at position cache['pos'][b] (a [B] vector)
+    -> (logits [B, V], cache with every pos advanced).
+
+    Identical math to `decode_step` per row — a slot decoding alone
+    produces the same logits as a batch-1 `generate()` at the same
+    position — but rows no longer share a position, which is what lets
+    requests at different depths share one dispatch."""
+    pos = cache["pos"]
+    x = (params["embed"][token][:, None, :]
+         + jnp.take(params["pos"], pos, axis=0)[:, None, :])
+    ks, vs = [], []
+    for i, layer in enumerate(params["layers"]):
+        a, nk, nv = _slot_attn(layer["attn"],
+                               _layer_norm(layer["ln1"], x),
+                               cache["k"][i], cache["v"][i], pos)
+        ks.append(nk)
+        vs.append(nv)
+        x = x + a
+        h = _layer_norm(layer["ln2"], x)
+        x = x + (_moe(layer["moe"], h, top_k=cfg.moe_top_k)
+                 if "moe" in layer else _mlp(layer["mlp"], h))
+    x = _layer_norm(params["ln_f"], x)
+    logits = jnp.einsum("bsd,dv->bsv", x, lm_head(params))[:, 0]
+    new_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs), "pos": pos + 1}
+    return logits, new_cache
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_slot_step(cfg: TransformerConfig):
+    """ONE jitted program per config for the whole serving lifetime: the
+    slot count is baked into the cache shapes, `pos` is a traced vector,
+    and the KV buffers are donated so the pool updates in place.
+
+    Per-slot sampling happens on device: `temperature[b] == 0` rows take
+    the argmax, sampled rows draw from `fold_in(PRNGKey(seed[b]),
+    count[b])` — deterministic per request regardless of how requests
+    interleave across dispatches."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def step(params, cache_k, cache_v, pos, token, temperature, seeds,
+             counts):
+        cache = {"k": cache_k, "v": cache_v, "pos": pos}
+        logits, cache = slot_decode_step(cfg, params, cache, token)
+        logits = logits.astype(jnp.float32)
+        greedy = jnp.argmax(logits, axis=-1)
+        keys = jax.vmap(lambda s, c: jax.random.fold_in(
+            jax.random.PRNGKey(s), c))(seeds, counts)
+        temp = jnp.maximum(temperature, 1e-6)[:, None]
+        sampled = jax.vmap(jax.random.categorical)(keys, logits / temp)
+        nxt = jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+        return nxt, cache["k"], cache["v"]
+
+    return step
+
+
+def make_slot_step(cfg: TransformerConfig):
+    """Compiled slot-step entry point for `serving.lm.ContinuousLMServer`:
+    fn(params, k, v, pos [B], token [B], temperature [B], seeds [B],
+    counts [B]) -> (next_token [B], k, v)."""
+    return _compiled_slot_step(cfg)
+
+
+# ---------------------------------------------------------------------------
 # Beam search (extension: the reference has no generative inference at all)
 
 @functools.lru_cache(maxsize=16)

@@ -9,6 +9,9 @@ first-class, per the survey's recommendation:
 - `StepTimer`: listener-shaped wall-clock stats (mean/p50/p95 step time,
   examples/sec) — drop it into the same listener slot as
   ScoreIterationListener.
+- `LatencyRecorder`: thread-safe reservoir of request latencies with
+  p50/p95/p99 summaries — the serving subsystem's per-request metric
+  primitive (`serving/metrics.py`).
 - `annotate(name)`: named span visible inside the device trace
   (jax.profiler.TraceAnnotation).
 - `device_memory_stats()`: per-device live/peak HBM bytes where the
@@ -17,10 +20,67 @@ first-class, per the survey's recommendation:
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import statistics
+import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
+
+
+def percentile(sorted_samples: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending-sorted sample list
+    (ceil-based rank — Python's round() half-to-even would bias p50/p99
+    LOW on half-integer ranks, e.g. median([1..5]) -> 2)."""
+    if not sorted_samples:
+        raise ValueError("percentile of an empty sample set")
+    import math
+
+    idx = min(len(sorted_samples) - 1,
+              max(0, math.ceil(q / 100.0 * len(sorted_samples)) - 1))
+    return float(sorted_samples[idx])
+
+
+class LatencyRecorder:
+    """Thread-safe sliding-window latency reservoir with percentile
+    summaries.  The window (default 4096 samples) bounds memory on a
+    long-lived server while keeping p99 meaningful at serving rates."""
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self._samples = collections.deque(maxlen=window)
+        self._count = 0
+        self._total = 0.0
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._samples.append(float(seconds))
+            self._count += 1
+            self._total += float(seconds)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def summary(self) -> Dict[str, float]:
+        """{count, window, mean_ms, p50/p95/p99_ms}.  `count` is the
+        lifetime total; mean and percentiles are all computed over the
+        same sliding window (`window` samples) so they stay mutually
+        consistent on long-lived servers."""
+        with self._lock:
+            samples = sorted(self._samples)
+            count = self._count
+        if not samples:
+            return {"count": 0}
+        return {
+            "count": count,
+            "window": len(samples),
+            "mean_ms": round(sum(samples) / len(samples) * 1e3, 3),
+            "p50_ms": round(percentile(samples, 50) * 1e3, 3),
+            "p95_ms": round(percentile(samples, 95) * 1e3, 3),
+            "p99_ms": round(percentile(samples, 99) * 1e3, 3),
+        }
 
 
 @contextlib.contextmanager

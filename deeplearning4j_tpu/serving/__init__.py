@@ -1,0 +1,42 @@
+"""High-throughput serving subsystem.
+
+The inference-side counterpart of the fused training driver
+(`runtime/fused.py`): where training amortizes dispatch overhead by
+scanning K optimizer steps per XLA call, serving amortizes it by
+coalescing K concurrent *requests* per device dispatch.
+
+- `MicroBatcher` — request queue coalescing concurrent requests within a
+  `max_wait_ms` window into one padded dispatch (`batcher.py`);
+- `BucketLadder` — fixed batch/length shape ladder so any traffic
+  pattern compiles a bounded, pre-warmable program set (`bucketing.py`);
+- `ServingEngine` — a MultiLayerNetwork behind batcher + ladder with an
+  explicit `warmup()` and a compile-count guard (`engine.py`);
+- `ContinuousLMServer` — slot-based continuous LM decode over one fixed
+  `[slots, max_len]` KV cache: finished sequences free their slot and
+  queued prompts join mid-flight (`lm.py`);
+- `ServingMetrics` — queue depth, batch occupancy, p50/p95/p99 latency,
+  requests/s and tokens/s (`metrics.py`), surfaced via the UI server's
+  `GET /serving/stats`.
+
+See docs/performance.md (serving cost model) and docs/architecture.md.
+"""
+
+from deeplearning4j_tpu.serving.batcher import MicroBatcher
+from deeplearning4j_tpu.serving.bucketing import (
+    BucketLadder,
+    DEFAULT_BATCH_BUCKETS,
+    pow2_length_buckets,
+)
+from deeplearning4j_tpu.serving.engine import ServingEngine
+from deeplearning4j_tpu.serving.lm import ContinuousLMServer
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+__all__ = [
+    "BucketLadder",
+    "ContinuousLMServer",
+    "DEFAULT_BATCH_BUCKETS",
+    "MicroBatcher",
+    "ServingEngine",
+    "ServingMetrics",
+    "pow2_length_buckets",
+]

@@ -1,0 +1,206 @@
+"""Dynamic micro-batching: coalesce concurrent requests into one dispatch.
+
+The single-request serving path pays one XLA dispatch (plus host->device
+transfer) PER request; for small models the dispatch overhead IS the
+request (docs/performance.md dispatch-overhead model).  The micro-batcher
+is the inference-side analog of the fused training driver: a request
+queue whose worker thread coalesces everything that arrives within a
+short window (`max_wait_ms`, up to `max_batch` rows) into ONE padded
+device dispatch, then slices the row-aligned results back per request.
+
+Correctness contract: the model's inference forward is row-independent
+(no batch statistics), so a request's rows produce bitwise-identical
+outputs whether dispatched alone or inside a coalesced padded batch —
+tests/test_serving.py pins this byte-for-byte under concurrency.
+
+Requests with different trailing shapes (e.g. different padded sequence
+buckets) never share a dispatch: the worker groups the queue head with
+same-shape followers and leaves the rest queued for the next cycle.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+
+class _Pending:
+    __slots__ = ("x", "mask", "event", "result", "error", "enqueued")
+
+    def __init__(self, x: np.ndarray, mask: Optional[np.ndarray]):
+        self.x = x
+        self.mask = mask
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.enqueued = time.perf_counter()
+
+    @property
+    def key(self):
+        """Dispatch-compatibility key: trailing shape + mask presence."""
+        return (self.x.shape[1:], self.x.dtype.str,
+                None if self.mask is None else self.mask.shape[1:])
+
+
+class MicroBatcher:
+    """Request queue + coalescing worker in front of a dispatch function.
+
+    `dispatch(x, mask, n_real)` receives the stacked real rows (the
+    callee pads to its bucket) and must return row-aligned outputs as a
+    numpy array of at least `n_real` rows.  `submit()` blocks the
+    calling thread until its slice of the result is ready and is safe to
+    call from any number of threads.
+    """
+
+    def __init__(self, dispatch: Callable, max_batch: int = 32,
+                 max_wait_ms: float = 2.0,
+                 metrics: Optional[ServingMetrics] = None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        self._dispatch = dispatch
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- client side ------------------------------------------------------
+
+    def submit(self, x: np.ndarray, mask: Optional[np.ndarray] = None,
+               timeout: Optional[float] = None) -> np.ndarray:
+        """Enqueue a [n, ...] request and block for its [n, ...] outputs."""
+        x = np.asarray(x)
+        if x.ndim < 2 or x.shape[0] < 1:
+            raise ValueError(f"request must be [n, ...] with n >= 1, got "
+                             f"shape {x.shape}")
+        if x.shape[0] > self.max_batch:
+            raise ValueError(f"request rows ({x.shape[0]}) exceed max_batch "
+                             f"({self.max_batch}); split the request")
+        item = _Pending(x, None if mask is None else np.asarray(mask))
+        with self._cond:
+            if not self._running:
+                self._start_locked()
+            self._queue.append(item)
+            self.metrics.set_queue_depth(len(self._queue))
+            self._cond.notify_all()
+        if not item.event.wait(timeout):
+            # Cancel rather than abandon: a still-queued request is
+            # removed (otherwise retry-on-timeout clients fill the queue
+            # with zombie work the device still executes); one the worker
+            # already took is in flight and cannot be recalled.
+            with self._cond:
+                try:
+                    self._queue.remove(item)
+                    self.metrics.set_queue_depth(len(self._queue))
+                except ValueError:
+                    pass  # worker took it: the dispatch is in flight
+            raise TimeoutError(f"serving request timed out after {timeout}s")
+        self.metrics.record_request(time.perf_counter() - item.enqueued)
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        # fail anything still queued rather than leaving clients hung
+        with self._cond:
+            leftovers = list(self._queue)
+            self._queue.clear()
+        for item in leftovers:
+            item.error = RuntimeError("batcher stopped")
+            item.event.set()
+
+    # ---- worker side ------------------------------------------------------
+
+    def _start_locked(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="micro-batcher")
+        self._thread.start()
+
+    def _collect(self):
+        """Take the queue head plus same-shape followers.
+
+        Two regimes, which is what makes the batcher both low-latency
+        and high-occupancy:
+
+        - worker BUSY (queue non-empty when it frees up): dispatch
+          immediately — the previous dispatch's duration already served
+          as the coalescing window, so waiting again only adds latency
+          (and on hosts with coarse timers, any timed wait costs ~1ms);
+        - worker IDLE (had to block for the head): hold the head open up
+          to `max_wait_ms` from its arrival so a burst's co-travellers
+          can join its dispatch.
+        """
+        with self._cond:
+            was_idle = not self._queue
+            while self._running and not self._queue:
+                self._cond.wait(0.1)
+            if not self._running:
+                return []
+            head = self._queue[0]
+            if was_idle and self.max_wait_s > 0:
+                deadline = head.enqueued + self.max_wait_s
+                while self._running:
+                    rows = sum(i.x.shape[0] for i in self._queue
+                               if i.key == head.key)
+                    remaining = deadline - time.perf_counter()
+                    if rows >= self.max_batch or remaining <= 0:
+                        break
+                    self._cond.wait(remaining)  # submits notify early
+            group, rows, rest = [], 0, collections.deque()
+            while self._queue:
+                item = self._queue.popleft()
+                if (item.key == head.key
+                        and rows + item.x.shape[0] <= self.max_batch):
+                    group.append(item)
+                    rows += item.x.shape[0]
+                else:
+                    rest.append(item)
+            self._queue.extend(rest)
+            self.metrics.set_queue_depth(len(self._queue))
+            return group
+
+    def _run(self) -> None:
+        while True:
+            group = self._collect()
+            if not group:
+                with self._cond:
+                    if not self._running:
+                        return
+                continue
+            try:
+                x = (group[0].x if len(group) == 1
+                     else np.concatenate([g.x for g in group], axis=0))
+                mask = None
+                if group[0].mask is not None:
+                    mask = (group[0].mask if len(group) == 1
+                            else np.concatenate([g.mask for g in group],
+                                                axis=0))
+                out = np.asarray(self._dispatch(x, mask, x.shape[0]))
+                off = 0
+                for g in group:
+                    n = g.x.shape[0]
+                    g.result = out[off:off + n]
+                    off += n
+            except BaseException as e:  # noqa: BLE001 — fail the GROUP, keep serving
+                for g in group:
+                    g.error = e
+            finally:
+                for g in group:
+                    g.event.set()

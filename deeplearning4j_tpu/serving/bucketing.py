@@ -1,0 +1,125 @@
+"""Shape bucketing for the serving path.
+
+XLA compiles one program per distinct input shape, so a serving process
+that dispatches whatever batch/length arrives compiles an unbounded
+program set — each new shape paying a full compile (seconds) on the
+request path.  The fix is the classic fixed-shape serving discipline
+(the O(1)-cache / compiler-first serving papers in PAPERS.md): pad every
+dispatch UP to a small fixed ladder of shapes so any traffic pattern
+executes a bounded, pre-warmable program set.
+
+`BucketLadder` owns the ladder: a short ascending list of batch buckets
+(default 1/8/32/128) and, for sequence models, a pow2 ladder of length
+buckets.  Padding never changes results: batch-dim padding rows are
+computed and sliced away (rows are independent in inference — no batch
+statistics), and length-dim padding is masked per example via the
+network's `[batch, time]` mask.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_BATCH_BUCKETS = (1, 8, 32, 128)
+
+
+def pow2_length_buckets(max_len: int, min_len: int = 16) -> Tuple[int, ...]:
+    """Powers of two from min_len up to (and including) max_len."""
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    out = []
+    b = max(1, min_len)
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+class BucketLadder:
+    """A fixed ladder of (batch[, length]) buckets.
+
+    `batch_bucket(n)` / `length_bucket(t)` return the smallest bucket
+    that fits; oversize requests raise (the caller — the micro-batcher —
+    enforces its own `max_batch` below the top bucket).  `program_bound`
+    is the worst-case number of distinct dispatch shapes the ladder can
+    produce — the serving engine's compile-count guard pins actual
+    compiles to it.
+    """
+
+    def __init__(self,
+                 batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
+                 length_buckets: Optional[Sequence[int]] = None):
+        self.batch_buckets = tuple(sorted(set(int(b) for b in batch_buckets)))
+        if not self.batch_buckets or self.batch_buckets[0] < 1:
+            raise ValueError(f"batch buckets must be positive ints, got "
+                             f"{batch_buckets}")
+        self.length_buckets = (None if length_buckets is None else
+                               tuple(sorted(set(int(b)
+                                                for b in length_buckets))))
+        if self.length_buckets is not None and (
+                not self.length_buckets or self.length_buckets[0] < 1):
+            raise ValueError(f"length buckets must be positive ints, got "
+                             f"{length_buckets}")
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_buckets[-1]
+
+    @property
+    def program_bound(self) -> int:
+        """Worst-case distinct dispatch shapes: |batch| x |length|."""
+        return len(self.batch_buckets) * (len(self.length_buckets)
+                                          if self.length_buckets else 1)
+
+    def batch_bucket(self, n: int) -> int:
+        """Smallest batch bucket >= n."""
+        if n < 1:
+            raise ValueError(f"batch must be >= 1, got {n}")
+        for b in self.batch_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"batch {n} exceeds the largest bucket "
+                         f"{self.batch_buckets[-1]}; split the request or "
+                         f"extend the ladder")
+
+    def length_bucket(self, t: int) -> int:
+        """Smallest length bucket >= t (requires a length ladder)."""
+        if self.length_buckets is None:
+            raise ValueError("this ladder has no length buckets")
+        if t < 1:
+            raise ValueError(f"length must be >= 1, got {t}")
+        for b in self.length_buckets:
+            if t <= b:
+                return b
+        raise ValueError(f"length {t} exceeds the largest bucket "
+                         f"{self.length_buckets[-1]}")
+
+    def pad_rows(self, x: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Zero-pad axis 0 up to the batch bucket: (padded, n_real)."""
+        n = int(x.shape[0])
+        b = self.batch_bucket(n)
+        if b == n:
+            return x, n
+        pad = np.zeros((b - n,) + x.shape[1:], x.dtype)
+        return np.concatenate([x, pad], axis=0), n
+
+    def pad_length(self, x: np.ndarray,
+                   mask: Optional[np.ndarray] = None
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        """Zero-pad axis 1 (time) of a [n, T, ...] batch up to the length
+        bucket and return (padded_x, mask) where mask is [n, T_bucket]
+        with 1.0 over real steps — padded steps contribute nothing to a
+        masked forward."""
+        t = int(x.shape[1])
+        tb = self.length_bucket(t)
+        if mask is None:
+            mask = np.ones(x.shape[:2], np.float32)
+        if tb == t:
+            return x, mask
+        pad_x = np.zeros((x.shape[0], tb - t) + x.shape[2:], x.dtype)
+        pad_m = np.zeros((x.shape[0], tb - t), np.float32)
+        return (np.concatenate([x, pad_x], axis=1),
+                np.concatenate([mask, pad_m], axis=1))

@@ -1,0 +1,167 @@
+"""ServingEngine: a MultiLayerNetwork behind the micro-batcher + ladder.
+
+The request path every endpoint shares:
+
+    client threads -> MicroBatcher (coalesce within max_wait_ms)
+                   -> BucketLadder (pad batch/length up the ladder)
+                   -> MultiLayerNetwork.output_bucketed (cached jitted
+                      forward, one program per ladder shape)
+                   -> slice rows back per request
+
+plus an explicit `warmup()` that pre-compiles every ladder shape before
+traffic, and a compile-count guard: dispatching a shape outside the
+ladder's bound raises instead of silently compiling program #N+1 on the
+request path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.batcher import MicroBatcher
+from deeplearning4j_tpu.serving.bucketing import BucketLadder
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+
+class ServingEngine:
+    """Thread-safe batched inference over one model.
+
+    `predict_proba(x)` / `predict(x)` accept a [n, ...] request (n up to
+    `max_batch`) from any thread; rows ride whatever dispatch the
+    batcher forms.  Sequence inputs ([n, T, ...]) are padded up the
+    length ladder with per-example masks, so padding never changes
+    results.
+    """
+
+    def __init__(self, net, ladder: Optional[BucketLadder] = None,
+                 max_batch: Optional[int] = None, max_wait_ms: float = 2.0,
+                 metrics: Optional[ServingMetrics] = None,
+                 max_programs: Optional[int] = None,
+                 input_dtype=np.float32):
+        self.net = net
+        self.ladder = ladder if ladder is not None else BucketLadder()
+        # every request is cast to ONE dtype (the one warmup() compiles)
+        # so client-side dtype drift (float64 lists, int features) can
+        # never mint extra programs or trip the guard; pass
+        # input_dtype=None for models whose inputs must stay integral
+        # (embedding front ends) — the guard then keys each dtype seen
+        self.input_dtype = (None if input_dtype is None
+                            else np.dtype(input_dtype))
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.max_programs = (max_programs if max_programs is not None
+                             else self.ladder.program_bound)
+        self._shape_lock = threading.Lock()
+        self._seen_shapes = {}   # dtype str -> set of dispatch shapes
+        self.batcher = MicroBatcher(
+            self._dispatch,
+            max_batch=(max_batch if max_batch is not None
+                       else self.ladder.max_batch),
+            max_wait_ms=max_wait_ms, metrics=self.metrics)
+        if self.batcher.max_batch > self.ladder.max_batch:
+            raise ValueError(
+                f"max_batch ({self.batcher.max_batch}) exceeds the "
+                f"ladder's top bucket ({self.ladder.max_batch})")
+
+    # ---- dispatch side ----------------------------------------------------
+
+    def _guard_shape(self, shape, dtype: str) -> None:
+        """Compile-count guard: a dispatch shape beyond the ladder bound
+        means bucketing failed — refuse to compile program #N+1.  The
+        bound is PER dtype: with the default `input_dtype` coercion only
+        one dtype ever occurs, and with `input_dtype=None` each client
+        dtype legitimately owns its own ladder-sized program set."""
+        with self._shape_lock:
+            seen = self._seen_shapes.setdefault(dtype, set())
+            if shape in seen:
+                return
+            if len(seen) >= self.max_programs:
+                raise RuntimeError(
+                    f"compile-count guard: dispatch shape {shape} "
+                    f"({dtype}) would exceed the {self.max_programs}-"
+                    f"program bound (seen: {sorted(seen)}); the bucket "
+                    f"ladder is not covering the traffic")
+            seen.add(shape)
+
+    def _dispatch(self, x: np.ndarray, mask: Optional[np.ndarray],
+                  n_real: int) -> np.ndarray:
+        bucket = self.ladder.batch_bucket(n_real)
+        self._guard_shape((bucket,) + tuple(x.shape[1:]), x.dtype.str)
+        out = self.net.output_bucketed(x, mask=mask, ladder=self.ladder)
+        self.metrics.record_dispatch(n_real, bucket)
+        return np.asarray(out)
+
+    # ---- client side ------------------------------------------------------
+
+    def _prepare(self, x):
+        """Normalize dtype (every request serves as `input_dtype` — the
+        dtype warmup() compiled), then length-bucket sequence inputs
+        (mask the padding).  Returns (x, mask, original_T)."""
+        x = np.asarray(x)
+        if self.input_dtype is not None and x.dtype != self.input_dtype:
+            x = x.astype(self.input_dtype)
+        if x.ndim >= 3 and self.ladder.length_buckets is not None:
+            t = int(x.shape[1])
+            x, mask = self.ladder.pad_length(x)
+            return x, mask, t
+        return x, None, None
+
+    def predict_proba(self, x, timeout: Optional[float] = None
+                      ) -> np.ndarray:
+        """[n, ...] features -> [n, classes] output activations (or
+        [n, T, classes] for sequence-tagging outputs, sliced back to the
+        request's own T)."""
+        x, mask, t = self._prepare(x)
+        out = self.batcher.submit(x, mask, timeout=timeout)
+        if t is not None and out.ndim == 3 and out.shape[1] != t:
+            out = out[:, :t]       # drop the length-bucket padding steps
+        return out
+
+    def predict(self, x, timeout: Optional[float] = None) -> np.ndarray:
+        """[n, ...] features -> [n] argmax class indices."""
+        return np.argmax(self.predict_proba(x, timeout=timeout), axis=-1)
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def warmup(self, example: np.ndarray) -> int:
+        """Pre-compile every ladder shape from one example row's shape
+        (`example` is [...] or [1, ...]); returns the number of shapes
+        warmed.  Run this before traffic: afterwards NO request can
+        trigger an XLA compile (the guard enforces it)."""
+        example = np.asarray(example)
+        row = (example[0] if example.ndim > 1 and example.shape[0] == 1
+               else example)
+        lengths = ([None] if row.ndim < 2 or self.ladder.length_buckets
+                   is None else list(self.ladder.length_buckets))
+        warmed = 0
+        dt = self.input_dtype if self.input_dtype is not None else np.float32
+        for b in self.ladder.batch_buckets:
+            for t in lengths:
+                shape = (b,) + ((t,) + row.shape[1:] if t is not None
+                                else row.shape)
+                x = np.zeros(shape, dt)
+                mask = (np.ones((b, t), np.float32) if t is not None
+                        else None)
+                # straight to the model — warmup is not traffic, so it
+                # registers shapes with the guard but not the metrics
+                self._guard_shape((b,) + tuple(x.shape[1:]), x.dtype.str)
+                self.net.output_bucketed(x, mask=mask, ladder=self.ladder)
+                warmed += 1
+        return warmed
+
+    def stats(self) -> Dict:
+        out = self.metrics.snapshot()
+        out["bucket_ladder"] = {
+            "batch": list(self.ladder.batch_buckets),
+            "length": (list(self.ladder.length_buckets)
+                       if self.ladder.length_buckets else None)}
+        with self._shape_lock:
+            out["compiled_programs"] = sum(
+                len(s) for s in self._seen_shapes.values())
+        out["program_bound"] = self.max_programs
+        return out
+
+    def stop(self) -> None:
+        self.batcher.stop()

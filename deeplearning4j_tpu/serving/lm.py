@@ -1,0 +1,313 @@
+"""Continuous batching for LM generation.
+
+`generate()` decodes one request (or one fixed batch) to completion:
+requests arriving mid-decode wait for the whole previous decode.  The
+continuous server instead keeps a fixed pool of `slots` decode lanes
+over ONE `[L, slots, max_len, H, K]` KV cache and advances every active
+lane one token per device step (`parallel.generation.make_slot_step`):
+
+- a finished sequence frees its slot immediately;
+- a queued prompt joins mid-flight — its slot restarts at position 0 and
+  its prompt tokens are teacher-forced through the same per-token step
+  (prefill-as-decode), so admission never interrupts other lanes;
+- every dispatch shape is fixed (`slots` lanes, whatever is inactive
+  rides as masked padding), so the WHOLE serving lifetime runs ONE
+  compiled program per config.
+
+Greedy and plain-temperature sampling run in the slot pool (sampling is
+seeded per request: `fold_in(PRNGKey(seed), tokens_generated)`, so a
+request's output does not depend on what shared its dispatches).
+top-k/top-p/beam requests take the legacy whole-sequence path in
+`ui/server.py` — their filters are static program variants, not per-slot
+switches.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+
+def validate_request(cfg, prompt_ids, max_new_tokens: int) -> List[int]:
+    """THE serving-request contract, shared by the HTTP endpoint (as
+    400s) and `ContinuousLMServer` (as ValueErrors): non-empty prompt of
+    in-vocab tokens, positive budget, and prompt + new tokens within the
+    model's fixed max_len cache.  A bad request must fail HERE, before
+    it reaches a decode worker — an error raised mid-drain fails every
+    co-travelling request in the slot pool."""
+    ids = [int(t) for t in prompt_ids]
+    if not ids:
+        raise ValueError("prompt_ids must contain at least one token")
+    bad = [t for t in ids if not 0 <= t < cfg.vocab_size]
+    if bad:
+        raise ValueError(f"prompt_ids outside vocab "
+                         f"[0, {cfg.vocab_size}): {bad[:5]}")
+    max_new = int(max_new_tokens)
+    if max_new < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+    if len(ids) + max_new > cfg.max_len:
+        raise ValueError(
+            f"prompt ({len(ids)} tokens) + max_new_tokens ({max_new}) "
+            f"exceeds max_len ({cfg.max_len}); shorten one of them")
+    return ids
+
+
+class _LMRequest:
+    __slots__ = ("prompt", "max_new", "temperature", "seed", "event",
+                 "result", "error", "enqueued")
+
+    def __init__(self, prompt: List[int], max_new: int, temperature: float,
+                 seed: int):
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.event = threading.Event()
+        self.result: Optional[List[int]] = None
+        self.error: Optional[BaseException] = None
+        self.enqueued = time.perf_counter()
+
+
+class _Slot:
+    __slots__ = ("req", "pos", "fed", "generated")
+
+    def __init__(self):
+        self.req: Optional[_LMRequest] = None
+        self.pos = 0          # next cache position to write
+        self.fed = 0          # prompt tokens already fed (prefill cursor)
+        self.generated: List[int] = []
+
+    @property
+    def active(self) -> bool:
+        return self.req is not None
+
+
+class ContinuousLMServer:
+    """Slot-based continuous decode over one TransformerLM.
+
+    `generate(prompt_ids, max_new_tokens)` is thread-safe and blocks
+    until the request's sequence is complete; any number of requests
+    share the device via the slot pool.
+    """
+
+    def __init__(self, cfg, params, slots: int = 4,
+                 metrics: Optional[ServingMetrics] = None):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.cfg = cfg
+        self.params = params
+        self.n_slots = int(slots)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._cache = None    # lazy: (k, v) device buffers
+        self._step = None
+        self._slots = [_Slot() for _ in range(self.n_slots)]
+        self._steps = 0
+
+    # ---- client side ------------------------------------------------------
+
+    def validate(self, prompt_ids, max_new_tokens: int) -> List[int]:
+        """`validate_request` against this server's config."""
+        return validate_request(self.cfg, prompt_ids, max_new_tokens)
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0, seed: int = 0,
+                 timeout: Optional[float] = None) -> List[int]:
+        """prompt ids -> full sequence (prompt + generated), blocking."""
+        ids = self.validate(prompt_ids, max_new_tokens)
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        # fold into int32 range (the device-side PRNGKey seed dtype) so a
+        # huge client seed cannot overflow the worker's seed vector
+        seed = int(seed) & 0x7FFFFFFF
+        req = _LMRequest(ids, int(max_new_tokens), temperature, seed)
+        with self._cond:
+            if not self._running:
+                self._start_locked()
+            self._queue.append(req)
+            self.metrics.set_queue_depth(len(self._queue))
+            self._cond.notify_all()
+        if not req.event.wait(timeout):
+            # Cancel rather than abandon (mirror of MicroBatcher.submit):
+            # a still-queued request is removed so retry-on-timeout
+            # clients cannot fill the pool with zombie decodes; one
+            # already in a slot is in flight and cannot be recalled.
+            with self._cond:
+                try:
+                    self._queue.remove(req)
+                    self.metrics.set_queue_depth(len(self._queue))
+                except ValueError:
+                    pass  # already admitted to a slot
+            raise TimeoutError(f"LM request timed out after {timeout}s")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        with self._cond:
+            leftovers = list(self._queue)
+            self._queue.clear()
+        for req in leftovers:
+            req.error = RuntimeError("LM server stopped")
+            req.event.set()
+
+    def stats(self) -> Dict:
+        out = self.metrics.snapshot()
+        with self._cond:
+            out["slots"] = self.n_slots
+            out["active_slots"] = sum(s.active for s in self._slots)
+            out["queue_depth"] = len(self._queue)
+            out["decode_steps"] = self._steps
+        out["max_len"] = self.cfg.max_len
+        out["compiled_programs"] = 1  # one slot program per config
+        return out
+
+    # ---- worker side ------------------------------------------------------
+
+    def _reset_cache(self) -> None:
+        """(Re)allocate the KV pool.  Needed after a FAILED dispatch
+        too: the step donates the k/v buffers, so an exception mid-step
+        leaves `self._cache` pointing at deleted buffers — without a
+        rebuild the keep-serving path would fail every later request."""
+        from deeplearning4j_tpu.parallel.generation import init_slot_cache
+
+        cache = init_slot_cache(self.cfg, self.n_slots)
+        self._cache = (cache["k"], cache["v"])
+
+    def _start_locked(self) -> None:
+        if self._step is None:
+            from deeplearning4j_tpu.parallel.generation import (
+                make_slot_step,
+            )
+
+            self._step = make_slot_step(self.cfg)
+            self._reset_cache()
+        self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="lm-decode")
+        self._thread.start()
+
+    def _admit_locked(self) -> None:
+        """Queued prompts join free slots; the slot restarts at position
+        0 — stale KV beyond a slot's position is masked, so no reset of
+        the cache buffers is needed."""
+        for slot in self._slots:
+            if not self._queue:
+                return
+            if slot.active:
+                continue
+            slot.req = self._queue.popleft()
+            slot.pos = 0
+            slot.fed = 0
+            slot.generated = []
+        self.metrics.set_queue_depth(len(self._queue))
+
+    def _drain(self) -> bool:
+        """One scheduling round: admit, build the step inputs, dispatch,
+        fold the sampled tokens back into each lane.  Returns False when
+        idle (nothing active, nothing queued)."""
+        with self._cond:
+            self._admit_locked()
+            active = [s for s in self._slots if s.active]
+            if not active:
+                return False
+        token = np.zeros((self.n_slots,), np.int32)
+        pos = np.zeros((self.n_slots,), np.int32)
+        temp = np.zeros((self.n_slots,), np.float32)
+        seeds = np.zeros((self.n_slots,), np.int32)
+        counts = np.zeros((self.n_slots,), np.int32)
+        for i, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            req = slot.req
+            if slot.fed < len(req.prompt):     # prefill: teacher-force
+                token[i] = req.prompt[slot.fed]
+            else:                              # decode: feed last sample
+                token[i] = slot.generated[-1]
+            pos[i] = slot.pos
+            temp[i] = req.temperature
+            seeds[i] = req.seed
+            counts[i] = len(slot.generated)
+        nxt, k, v = self._step(self.params, *self._cache, pos, token,
+                               temp, seeds, counts)
+        self._cache = (k, v)
+        nxt = np.asarray(nxt)
+        self._steps += 1
+        emitted = 0
+        for i, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            slot.pos += 1
+            if slot.fed < len(slot.req.prompt):
+                slot.fed += 1
+                # the LAST prompt token's logits yield the first sample
+                if slot.fed < len(slot.req.prompt):
+                    continue
+            slot.generated.append(int(nxt[i]))
+            emitted += 1
+            if len(slot.generated) >= slot.req.max_new:
+                slot.req.result = slot.req.prompt + slot.generated
+                self.metrics.record_request(
+                    time.perf_counter() - slot.req.enqueued)
+                slot.req.event.set()
+                slot.req = None
+        self.metrics.record_dispatch(len(active), self.n_slots)
+        if emitted:
+            self.metrics.record_tokens(emitted)
+        return True
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                if not self._running:
+                    # abort in-flight + queued rather than leaving clients
+                    # blocked on a dead worker
+                    victims = [s.req for s in self._slots if s.active]
+                    victims += list(self._queue)
+                    for s in self._slots:
+                        s.req = None
+                    self._queue.clear()
+                    for r in victims:
+                        r.error = RuntimeError("LM server stopped")
+                        r.event.set()
+                    return
+            try:
+                busy = self._drain()
+            except BaseException as e:  # noqa: BLE001 — fail in-flight, keep serving
+                with self._cond:
+                    victims = [s for s in self._slots if s.active]
+                    for s in victims:
+                        s.req.error = e
+                        s.req.event.set()
+                        s.req = None
+                # the failed step may have consumed its donated k/v
+                # buffers; rebuild so later requests get a live cache
+                # (their slots restart at pos 0 — no state to preserve)
+                try:
+                    self._reset_cache()
+                except BaseException:  # noqa: BLE001 — device truly gone
+                    pass
+                busy = True
+            if not busy:
+                with self._cond:
+                    if not self._running:
+                        return
+                    if not self._queue:
+                        self._cond.wait(0.05)
+            else:
+                time.sleep(0)  # yield: let submitters enqueue mid-decode

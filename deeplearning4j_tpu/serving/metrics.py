@@ -1,0 +1,107 @@
+"""Serving metrics: the numbers that tell you whether batching is working.
+
+Per the serving cost model (docs/performance.md): throughput is bought
+with batch occupancy (real rows per dispatch) and bounded compiles;
+latency is spent in queue wait plus device compute.  `ServingMetrics`
+tracks both sides — per-request latency percentiles via
+`runtime.profiler.LatencyRecorder`, and per-dispatch occupancy / queue
+depth / token counts — and snapshots them for `GET /serving/stats` and
+the bench rows.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from deeplearning4j_tpu.runtime.profiler import LatencyRecorder
+
+
+class ServingMetrics:
+    """Thread-safe counters shared by the micro-batcher and LM server."""
+
+    def __init__(self, latency_window: int = 4096):
+        self._lock = threading.Lock()
+        self.latency = LatencyRecorder(window=latency_window)
+        self._dispatches = 0
+        self._requests = 0
+        self._rows = 0          # real examples dispatched
+        self._padded_rows = 0   # bucket capacity dispatched (incl. padding)
+        self._tokens = 0        # LM tokens emitted
+        self._queue_depth = 0
+        self._max_occupancy = 0
+        self._started: Optional[float] = None
+
+    # ---- recording --------------------------------------------------------
+
+    def _touch(self) -> None:
+        if self._started is None:
+            self._started = time.perf_counter()
+
+    def record_dispatch(self, n_real: int, n_padded: int,
+                        queue_depth: Optional[int] = None) -> None:
+        with self._lock:
+            self._touch()
+            self._dispatches += 1
+            self._rows += int(n_real)
+            self._padded_rows += int(n_padded)
+            if queue_depth is not None:  # None = depth owned by the queue
+                self._queue_depth = int(queue_depth)
+            self._max_occupancy = max(self._max_occupancy, int(n_real))
+
+    def record_request(self, latency_s: float) -> None:
+        with self._lock:
+            self._touch()
+            self._requests += 1
+        self.latency.record(latency_s)
+
+    def record_tokens(self, n: int) -> None:
+        with self._lock:
+            self._touch()
+            self._tokens += int(n)
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self._queue_depth = int(depth)
+
+    # ---- reading ----------------------------------------------------------
+
+    @property
+    def dispatches(self) -> int:
+        with self._lock:
+            return self._dispatches
+
+    @property
+    def max_occupancy(self) -> int:
+        """Largest real-row count observed in one dispatch."""
+        with self._lock:
+            return self._max_occupancy
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            elapsed = (time.perf_counter() - self._started
+                       if self._started is not None else 0.0)
+            dispatches, requests = self._dispatches, self._requests
+            rows, padded = self._rows, self._padded_rows
+            tokens, depth = self._tokens, self._queue_depth
+            max_occ = self._max_occupancy
+        out = {
+            "requests": requests,
+            "dispatches": dispatches,
+            "rows": rows,
+            "queue_depth": depth,
+            "latency": self.latency.summary(),
+        }
+        if dispatches:
+            out["mean_batch_occupancy"] = round(rows / dispatches, 3)
+            out["max_batch_occupancy"] = max_occ
+            # fraction of dispatched device rows that were real examples
+            out["pad_efficiency"] = round(rows / max(padded, 1), 3)
+        if elapsed > 0:
+            out["requests_per_sec"] = round(requests / elapsed, 1)
+            if tokens:
+                out["tokens_per_sec"] = round(tokens / elapsed, 1)
+        if tokens:
+            out["tokens"] = tokens
+        return out

@@ -15,9 +15,21 @@ Endpoint parity with `UiServer.run():75-87`:
 - GET  /weights               latest + history summary     (WeightResource)
 - GET  /activations           activation grid as nested lists
 - POST /activations           upload an activation grid    (ActivationsResource)
-- POST /lm/generate           KV-cached LM generation for the model
-                              registered via UiServer.serve_lm(cfg, params)
+- POST /lm/generate           LM generation for the model registered via
+                              UiServer.serve_lm(cfg, params): greedy /
+                              plain-temperature requests ride the
+                              continuous slot-decode pool
+                              (serving.ContinuousLMServer); top-k/top-p/
+                              beam take the whole-sequence KV path
                               (beyond the reference: LM serving)
+- POST /model/predict         batched classifier/regressor inference for
+                              the model registered via
+                              UiServer.serve_model(net) — concurrent
+                              requests coalesce in the serving engine's
+                              dynamic micro-batcher
+- GET  /serving/stats         serving metrics: queue depth, batch
+                              occupancy, p50/p95/p99 latency, requests/s,
+                              tokens/s, compiled program counts
 
 All payloads are JSON. `port=0` picks a free port (tests).
 """
@@ -122,6 +134,8 @@ class _UiState:
         self.weights_history: List[dict] = []
         self.activations: Optional[List] = None
         self.lm = None  # (TransformerConfig, params) via serve_lm
+        self.lm_server = None  # serving.ContinuousLMServer via serve_lm
+        self.engine = None     # serving.ServingEngine via serve_model
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -176,6 +190,11 @@ class _Handler(BaseHTTPRequestHandler):
                     else None})
             elif self.path == "/activations":
                 self._json(200, {"activations": s.activations})
+            elif self.path == "/serving/stats":
+                engine, lm_server = s.engine, s.lm_server
+                self._json(200, {
+                    "classifier": engine.stats() if engine else None,
+                    "lm": lm_server.stats() if lm_server else None})
             else:
                 self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -258,47 +277,112 @@ class _Handler(BaseHTTPRequestHandler):
                 s.activations = body["activations"]
             self._json(200, {"ok": True})
         elif self.path == "/lm/generate":
-            # Serve the registered TransformerLM (UiServer.serve_lm) via the
-            # KV-cached decoder — LM serving the 2015 reference never had.
+            self._lm_generate(body)
+        elif self.path == "/model/predict":
+            # Batched classifier inference (UiServer.serve_model): the
+            # request's rows ride whatever coalesced dispatch the
+            # micro-batcher forms with concurrently-arriving requests.
             with s.lock:
-                lm = s.lm
-            if lm is None:
-                self._json(400, {"error": "no LM registered: call "
-                                          "UiServer.serve_lm(cfg, params)"})
+                engine = s.engine
+            if engine is None:
+                self._json(400, {"error": "no model registered: call "
+                                          "UiServer.serve_model(net)"})
+                return
+            feats = body.get("features")
+            if not feats:
+                self._json(400, {"error": "features required"})
+                return
+            try:
+                x = np.asarray(feats, np.float32)
+                probs = engine.predict_proba(x)
+            except (ValueError, TypeError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            self._json(200, {
+                "predictions": np.argmax(probs, axis=-1).tolist(),
+                "outputs": np.asarray(probs).tolist()})
+        else:
+            self._json(404, {"error": f"unknown path {self.path}"})
+
+    def _lm_generate(self, body: Any) -> None:
+        """POST /lm/generate — LM serving the 2015 reference never had.
+        Greedy / plain-temperature requests go through the continuous
+        slot-decode pool; top-k/top-p/beam take the whole-sequence
+        KV-cached path.  Oversized requests are client errors (400 with
+        the limit), never a silently-clipped cache write."""
+        s = self.state
+        with s.lock:
+            lm, lm_server = s.lm, s.lm_server
+        if lm is None:
+            self._json(400, {"error": "no LM registered: call "
+                                      "UiServer.serve_lm(cfg, params)"})
+            return
+        cfg, params = lm
+        prompt = body.get("prompt_ids")
+        if not prompt:
+            self._json(400, {"error": "prompt_ids required"})
+            return
+        from deeplearning4j_tpu.serving.lm import validate_request
+
+        # Validate BEFORE anything touches the fixed-size KV cache, via
+        # the ONE shared request contract (serving.lm.validate_request):
+        # an oversized request must become a 400 naming the limit, not a
+        # dynamic_update_slice running past the cache, and out-of-vocab
+        # ids must 400 on EVERY decode path (the whole-sequence legs
+        # would otherwise index-clamp them into garbage 200s).
+        try:
+            max_new = int(body.get("max_new_tokens", 32))
+            beams = int(body.get("beam_size", 0))
+            temperature = float(body.get("temperature", 0.0))
+            top_k = int(body.get("top_k", 0))
+            top_p = float(body.get("top_p", 1.0))
+            # fold into int32 range: PRNGKey/device seed dtype
+            seed = int(body.get("seed", 0)) & 0x7FFFFFFF
+            ids_list = validate_request(cfg, prompt, max_new)
+            if temperature < 0:
+                raise ValueError(f"temperature must be >= 0, "
+                                 f"got {temperature}")
+            if top_k < 0:
+                raise ValueError(f"top_k must be >= 0, got {top_k}")
+            if not 0.0 < top_p <= 1.0:
+                raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        except (ValueError, TypeError) as e:
+            # bad prompt/params (incl. null/list-valued knobs) -> 400
+            payload = {"error": str(e)}
+            if "max_len" in payload["error"]:
+                payload["max_len"] = cfg.max_len
+            self._json(400, payload)
+            return
+        try:
+            if beams > 1:
+                from deeplearning4j_tpu.parallel import beam_search
+
+                out, scores = beam_search(
+                    cfg, params, np.asarray([ids_list], np.int32),
+                    max_new_tokens=max_new, beam_size=beams)
+                self._json(200, {"ids": np.asarray(out)[0].tolist(),
+                                 "score": float(scores[0])})
+                return
+            if (lm_server is not None and top_k == 0 and top_p >= 1.0):
+                # continuous path: the request shares the slot pool with
+                # whatever else is decoding right now
+                ids = lm_server.generate(ids_list, max_new,
+                                         temperature=temperature,
+                                         seed=seed)
+                self._json(200, {"ids": ids})
                 return
             import jax
 
-            from deeplearning4j_tpu.parallel import beam_search, generate
+            from deeplearning4j_tpu.parallel import generate
 
-            cfg, params = lm
-            prompt = body.get("prompt_ids")
-            if not prompt:
-                self._json(400, {"error": "prompt_ids required"})
-                return
-            try:
-                ids = np.asarray([prompt], np.int32)
-                max_new = int(body.get("max_new_tokens", 32))
-                beams = int(body.get("beam_size", 0))
-                if beams > 1:
-                    out, scores = beam_search(cfg, params, ids,
-                                              max_new_tokens=max_new,
-                                              beam_size=beams)
-                    self._json(200, {"ids": np.asarray(out)[0].tolist(),
-                                     "score": float(scores[0])})
-                    return
-                out = generate(
-                    cfg, params, ids, max_new_tokens=max_new,
-                    temperature=float(body.get("temperature", 0.0)),
-                    top_k=int(body.get("top_k", 0)),
-                    top_p=float(body.get("top_p", 1.0)),
-                    rng=jax.random.PRNGKey(int(body.get("seed", 0))))
-            except (ValueError, TypeError) as e:
-                # bad prompt/params (incl. null/list-valued knobs) -> 400
-                self._json(400, {"error": str(e)})
-                return
-            self._json(200, {"ids": np.asarray(out)[0].tolist()})
-        else:
-            self._json(404, {"error": f"unknown path {self.path}"})
+            out = generate(
+                cfg, params, np.asarray([ids_list], np.int32),
+                max_new_tokens=max_new, temperature=temperature,
+                top_k=top_k, top_p=top_p, rng=jax.random.PRNGKey(seed))
+        except (ValueError, TypeError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        self._json(200, {"ids": np.asarray(out)[0].tolist()})
 
 
 class UiServer:
@@ -319,10 +403,42 @@ class UiServer:
     def state(self) -> _UiState:
         return self._server.ui_state  # type: ignore[attr-defined]
 
-    def serve_lm(self, cfg, params) -> "UiServer":
-        """Register a TransformerLM for POST /lm/generate."""
+    def serve_lm(self, cfg, params, slots: int = 4,
+                 continuous: bool = True) -> "UiServer":
+        """Register a TransformerLM for POST /lm/generate.  With
+        `continuous` (default) greedy/temperature requests decode in a
+        `slots`-lane continuous batching pool; `continuous=False` keeps
+        every request on the whole-sequence path."""
+        lm_server = None
+        if continuous:
+            from deeplearning4j_tpu.serving import ContinuousLMServer
+
+            lm_server = ContinuousLMServer(cfg, params, slots=slots)
         with self.state.lock:
             self.state.lm = (cfg, params)
+            old = self.state.lm_server
+            self.state.lm_server = lm_server
+        if old is not None:
+            old.stop()
+        return self
+
+    def serve_model(self, net, max_batch: int = 32,
+                    max_wait_ms: float = 2.0, ladder=None,
+                    warmup_example=None) -> "UiServer":
+        """Register a MultiLayerNetwork behind the dynamic micro-batcher
+        for POST /model/predict.  `warmup_example` (one example row) pre-
+        compiles every bucket-ladder shape before traffic."""
+        from deeplearning4j_tpu.serving import ServingEngine
+
+        engine = ServingEngine(net, ladder=ladder, max_batch=max_batch,
+                               max_wait_ms=max_wait_ms)
+        if warmup_example is not None:
+            engine.warmup(warmup_example)
+        with self.state.lock:
+            old = self.state.engine
+            self.state.engine = engine
+        if old is not None:
+            old.stop()
         return self
 
     def start(self) -> "UiServer":
@@ -332,3 +448,11 @@ class UiServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        with self.state.lock:
+            engine, lm_server = self.state.engine, self.state.lm_server
+            self.state.engine = None
+            self.state.lm_server = None
+        if engine is not None:
+            engine.stop()
+        if lm_server is not None:
+            lm_server.stop()

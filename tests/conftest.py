@@ -31,6 +31,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: fault-injection recovery tests (CPU-only, "
         "fast; run in tier-1)")
+    config.addinivalue_line(
+        "markers", "serving: serving-engine tests — micro-batcher, bucket "
+        "ladder, continuous LM decode (fast; run in tier-1)")
 
 
 @pytest.fixture

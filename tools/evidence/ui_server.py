@@ -67,6 +67,22 @@ def main() -> None:
                                  "max_new_tokens": 6, "beam_size": 3})
     print("lm/generate beam:", beam["ids"], "score",
           round(beam["score"], 3))
+    greedy = post("/lm/generate", {"prompt_ids": [104, 105],
+                                   "max_new_tokens": 6})
+    print("lm/generate continuous (slot pool):", greedy["ids"])
+    # batched classifier serving (serving/: micro-batcher + bucket ladder)
+    from deeplearning4j_tpu.models import MultiLayerNetwork, iris_mlp
+
+    net = MultiLayerNetwork(iris_mlp()).init()
+    srv.serve_model(net, max_batch=8,
+                    warmup_example=np.zeros((4,), np.float32))
+    pred = post("/model/predict", {"features": [[0.1, 0.2, 0.3, 0.4],
+                                                [1.0, 0.9, 0.8, 0.7]]})
+    print("model/predict:", pred["predictions"])
+    stats = json.loads(get("/serving/stats"))
+    print("serving/stats: classifier programs",
+          stats["classifier"]["compiled_programs"], "| lm slots",
+          stats["lm"]["slots"], "tokens", stats["lm"].get("tokens"))
     srv.stop()
     print("GREEN: all UI endpoints served over HTTP")
 

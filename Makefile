@@ -26,9 +26,10 @@ bench:
 smoke:
 	BENCH_ONLY=lenet,transformer python bench.py
 
-# Serving throughput rows only (micro-batched classifier + continuous LM).
+# Serving throughput rows only (micro-batched classifier + continuous LM
+# + the overload/admission-control row).
 serving-smoke:
-	BENCH_ONLY=serving,servinglm python bench.py
+	BENCH_ONLY=serving,servinglm,servingoverload python bench.py
 
 # Regenerate every committed EVIDENCE/ artifact (see EVIDENCE/README.md).
 # Each runner re-execs itself into a scrubbed 8-virtual-CPU-device env,

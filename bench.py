@@ -903,6 +903,92 @@ def bench_serving() -> dict:
             "bucket_ladder": stats.get("bucket_ladder")}
 
 
+def bench_serving_overload() -> dict:
+    """Overload row (ISSUE-4): a concurrency-32 storm against the
+    serving engine with and without admission control.  Without it the
+    queue is unbounded — every request eventually serves, but tail
+    latency is the whole backlog.  With `max_queue_depth` + per-request
+    deadlines the engine sheds what it cannot serve in time (503/504 in
+    HTTP terms) and the p99 of what it DOES serve stays bounded.  The
+    row reports completed requests/s, p99, and the shed rate for the
+    admission-controlled leg, with the uncontrolled leg alongside."""
+    import threading
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork, mnist_mlp
+    from deeplearning4j_tpu.serving import (
+        BucketLadder,
+        DeadlineExceededError,
+        ServingEngine,
+        ServingOverloadError,
+    )
+
+    conc = 32
+    total = conc * max(8, STEPS // 10)
+    net = MultiLayerNetwork(mnist_mlp()).init()
+    rng = np.random.default_rng(0)
+    reqs = [rng.random((1, 784)).astype(np.float32) for _ in range(total)]
+
+    def one_storm(max_queue_depth, deadline_s):
+        engine = ServingEngine(net, ladder=BucketLadder((1, 8, 16, 32)),
+                               max_wait_ms=2.0,
+                               max_queue_depth=max_queue_depth,
+                               default_deadline_s=deadline_s)
+        engine.warmup(np.zeros((784,), np.float32))
+        lock = threading.Lock()
+        outcomes = {"ok": 0, "shed": 0}
+
+        def handler(x):
+            try:
+                engine.predict_proba(x, timeout=120)
+                key = "ok"
+            except (ServingOverloadError, DeadlineExceededError):
+                key = "shed"   # admission rejection or deadline shed
+            with lock:
+                outcomes[key] += 1
+
+        try:
+            sec = _serving_storm(conc, reqs, handler)
+            stats = engine.stats()
+        finally:
+            engine.stop()
+        lat = stats.get("latency", {})
+        return {"sec": sec, "ok": outcomes["ok"],
+                "shed_rate": round(outcomes["shed"] / total, 3),
+                "p99_ms": lat.get("p99_ms"),
+                "rejected": stats.get("rejected"),
+                "deadline_missed": stats.get("deadline_missed")}
+
+    def storm(max_queue_depth, deadline_s):
+        # best-of-2 per leg: same thread-scheduling-noise policy as the
+        # other serving rows
+        return min((one_storm(max_queue_depth, deadline_s)
+                    for _ in range(2)), key=lambda r: r["sec"])
+
+    # the storm is closed-loop (each client has ONE outstanding request),
+    # so queue depth tops out at conc-1: the bound must sit BELOW that
+    # for admission control to actually engage
+    queue_bound = max(2, conc // 4)
+    open_loop = storm(max_queue_depth=None, deadline_s=None)
+    bounded = storm(max_queue_depth=queue_bound, deadline_s=0.5)
+    return {"metric": "MLP-classifier serving under overload "
+                      f"(concurrency {conc}, admission-controlled)",
+            "unit": "requests/sec",
+            "value": round(bounded["ok"] / bounded["sec"], 1),
+            "concurrency": conc, "requests": total,
+            "max_queue_depth": queue_bound, "deadline_ms": 500,
+            "p99_ms": bounded["p99_ms"],
+            "shed_rate": bounded["shed_rate"],
+            "rejected": bounded["rejected"],
+            "deadline_missed": bounded["deadline_missed"],
+            "uncontrolled_requests_per_sec": round(
+                open_loop["ok"] / open_loop["sec"], 1),
+            "uncontrolled_p99_ms": open_loop["p99_ms"],
+            "uncontrolled_shed_rate": open_loop["shed_rate"],
+            "model": "mnist-mlp 784-2048-2048-10",
+            "note": "shed work answers in microseconds (503/504); "
+                    "completed work keeps the bounded queue's p99"}
+
+
 def bench_serving_lm() -> dict:
     """Continuous LM decode (slot pool, prompts join mid-flight) vs the
     pre-serving behavior: concurrent requests served one-at-a-time, each
@@ -1013,6 +1099,7 @@ BENCHES = {
     "decode": bench_decode,
     "serving": bench_serving,
     "servinglm": bench_serving_lm,
+    "servingoverload": bench_serving_overload,
     "flashab": bench_flash_ab,
     "longctx": bench_longctx,
     "gpt2mem": bench_gpt2_mem,

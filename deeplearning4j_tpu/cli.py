@@ -363,13 +363,22 @@ def _load_saved_lm(out: pathlib.Path):
 
 def cmd_serve(args) -> int:
     """Serve a saved model and/or LM over HTTP with dynamic
-    micro-batching, shape-bucketed compilation and continuous LM decode
-    (deeplearning4j_tpu/serving/; cost model in docs/performance.md)."""
+    micro-batching, shape-bucketed compilation, continuous LM decode and
+    the serving-plane resilience layer: bounded admission, per-request
+    deadlines, circuit breaker, and SIGTERM graceful drain
+    (deeplearning4j_tpu/serving/; docs/robustness.md "serving plane")."""
+    import signal
+    import threading
+
     from deeplearning4j_tpu.serving import BucketLadder
     from deeplearning4j_tpu.ui.server import UiServer
 
     if not args.model and not args.lm:
         raise SystemExit("serve needs -model and/or -lm")
+    max_queue = args.max_queue if args.max_queue > 0 else None
+    deadline_s = args.deadline_ms / 1e3 if args.deadline_ms > 0 else None
+    breaker_n = (args.breaker_threshold if args.breaker_threshold > 0
+                 else None)
     srv = UiServer(host=args.host, port=args.port)
     if args.model:
         net = _build_net(args.model)
@@ -377,7 +386,10 @@ def cmd_serve(args) -> int:
             int(b) for b in args.buckets.split(",")))
         srv.serve_model(net,
                         max_batch=min(args.max_batch, ladder.max_batch),
-                        max_wait_ms=args.max_wait_ms, ladder=ladder)
+                        max_wait_ms=args.max_wait_ms, ladder=ladder,
+                        max_queue_depth=max_queue,
+                        default_deadline_s=deadline_s,
+                        breaker_threshold=breaker_n)
         from deeplearning4j_tpu.nn.conf import DenseLayerConf
 
         first = net.conf.layers[0]
@@ -395,22 +407,57 @@ def cmd_serve(args) -> int:
                   "bucket compiles instead")
     if args.lm:
         cfg, params = _load_saved_lm(pathlib.Path(args.lm))
-        srv.serve_lm(cfg, params, slots=args.lm_slots)
+        srv.serve_lm(cfg, params, slots=args.lm_slots,
+                     max_queue_depth=max_queue,
+                     default_deadline_s=deadline_s,
+                     breaker_threshold=breaker_n)
         print(f"serve: LM registered ({cfg.n_layers}L/d{cfg.d_model}, "
               f"max_len {cfg.max_len}, {args.lm_slots} decode slots)")
     srv.start()
+    print(f"serve: resilience max_queue={max_queue or 'unbounded'} "
+          f"deadline_ms={args.deadline_ms or 'none'} "
+          f"breaker_threshold={breaker_n or 'off'} "
+          f"drain_grace_s={args.drain_grace_s}")
     print(f"Serving on {srv.url} — POST /model/predict, /lm/generate; "
-          f"GET /serving/stats")
+          f"GET /serving/stats, /healthz, /readyz")
+
+    # SIGTERM -> graceful drain (the serving analog of the training
+    # supervisor's preemption handler): stop admission, let in-flight
+    # work finish within the grace window, snapshot /serving/stats to
+    # disk so the shed/rejected ledger survives the pod.
+    term = threading.Event()
+    installed = prev = None
+    if threading.current_thread() is threading.main_thread():
+        prev = signal.signal(signal.SIGTERM, lambda *_: term.set())
+        installed = True
     try:
         if args.serve_seconds > 0:
-            time.sleep(args.serve_seconds)
+            term.wait(args.serve_seconds)
         else:
-            while True:
-                time.sleep(3600)
+            while not term.wait(3600):
+                pass
     except KeyboardInterrupt:
         pass
     finally:
+        if term.is_set():
+            print(f"serve: SIGTERM — draining (grace "
+                  f"{args.drain_grace_s}s)")
+            drained = srv.drain(args.drain_grace_s)
+            stats_path = pathlib.Path(args.drain_stats)
+            try:
+                stats_path.write_text(json.dumps(srv.serving_stats(),
+                                                 indent=2))
+                where = str(stats_path)
+            except OSError as e:
+                # a lost snapshot must not leave the HTTP server
+                # unstopped or the signal handler unrestored
+                where = f"LOST ({e})"
+            print(f"serve: drain "
+                  f"{'complete' if drained else 'grace expired'}; stats "
+                  f"snapshot -> {where}")
         srv.stop()
+        if installed:
+            signal.signal(signal.SIGTERM, prev)
     return 0
 
 
@@ -811,6 +858,31 @@ def build_parser() -> argparse.ArgumentParser:
     p_serve.add_argument("-warmup", "--warmup", action="store_true",
                          help="pre-compile every bucket shape before "
                               "accepting traffic")
+    p_serve.add_argument("-max-queue", "--max-queue", dest="max_queue",
+                         type=int, default=256,
+                         help="bounded admission: queued requests past "
+                              "this depth are refused with HTTP 503 + "
+                              "Retry-After (0 = unbounded)")
+    p_serve.add_argument("-deadline-ms", "--deadline-ms",
+                         dest="deadline_ms", type=float, default=0,
+                         help="default per-request deadline; expired "
+                              "requests are shed before dispatch as 504 "
+                              "(0 = none; per-request deadline_ms / "
+                              "X-Deadline-Ms override)")
+    p_serve.add_argument("-breaker-threshold", "--breaker-threshold",
+                         dest="breaker_threshold", type=int, default=5,
+                         help="circuit breaker: consecutive whole-"
+                              "dispatch failures before fast-failing "
+                              "admission (0 = disabled)")
+    p_serve.add_argument("-drain-grace-s", "--drain-grace-s",
+                         dest="drain_grace_s", type=float, default=5.0,
+                         help="SIGTERM grace window: seconds to let "
+                              "queued + in-flight work finish before "
+                              "stopping")
+    p_serve.add_argument("-drain-stats", "--drain-stats",
+                         dest="drain_stats", default="serving_stats.json",
+                         help="path for the /serving/stats snapshot "
+                              "written on SIGTERM drain")
     p_serve.add_argument("-lm-slots", "--lm-slots", dest="lm_slots",
                          type=int, default=4,
                          help="continuous-decode lanes for /lm/generate")

@@ -26,6 +26,9 @@ keep going:
 from deeplearning4j_tpu.resilience.chaos import (
     ChaosConfig,
     ChaosDataSource,
+    InjectedDispatchFault,
+    ServingChaosConfig,
+    chaos_dispatch,
     chaos_runner,
 )
 from deeplearning4j_tpu.resilience.faults import (
@@ -51,6 +54,9 @@ from deeplearning4j_tpu.resilience.watchdog import StepWatchdog
 __all__ = [
     "ChaosConfig",
     "ChaosDataSource",
+    "InjectedDispatchFault",
+    "ServingChaosConfig",
+    "chaos_dispatch",
     "chaos_runner",
     "FaultReport",
     "PreemptedError",

@@ -20,6 +20,14 @@ at *configured batch indices* — no randomness, same failures every run:
 ``__next__`` does not kill it, so the supervisor's retry/resume paths can
 keep pulling from the same source — including re-entering it after a
 preemption-restart with its position intact.
+
+The SERVING plane gets the same discipline (ISSUE-4): `chaos_dispatch`
+wraps a micro-batcher dispatch function so whole-dispatch faults fire at
+configured dispatch indices (drives the circuit breaker), slow
+dispatches fire at configured indices (drives overload/deadline
+shedding), and any request whose rows are entirely `poison_value` fails
+its dispatch (drives poison-request bisection) — all deterministic, all
+CPU-only, so every serving recovery path runs in tier-1.
 """
 
 from __future__ import annotations
@@ -114,3 +122,73 @@ def chaos_runner(runner, config: ChaosConfig):
     at each step index in ``config.hang_steps`` — drives the watchdog
     path.  All other attributes delegate to the wrapped runner."""
     return _ChaosRunner(runner, config)
+
+
+# ---------------------------------------------------------------------------
+# Serving-plane fault injection (ISSUE-4)
+
+
+class InjectedDispatchFault(RuntimeError):
+    """The typed failure `chaos_dispatch` raises — tests match on it,
+    and it must never be confused with a real device error."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingChaosConfig:
+    """Dispatch indices (0-based, in call order) at which to inject
+    serving faults, plus the poison-row sentinel.
+
+    - ``fail_dispatch_steps``: the dispatch at each index raises
+      `InjectedDispatchFault` (consecutive indices drive the circuit
+      breaker open; the first non-listed index is the half-open probe
+      that closes it again);
+    - ``slow_dispatch_steps``: the dispatch sleeps ``slow_seconds``
+      first (drives queue build-up -> overload rejection and deadline
+      shedding);
+    - ``poison_value``: any dispatch whose batch contains a row made
+      ENTIRELY of this value raises — the deterministic stand-in for a
+      request whose payload crashes the device program.  Bisection must
+      isolate exactly those rows' requests.
+    """
+
+    fail_dispatch_steps: Sequence[int] = ()
+    slow_dispatch_steps: Sequence[int] = ()
+    slow_seconds: float = 0.05
+    poison_value: Optional[float] = None
+
+
+class _ChaosDispatch:
+    """Dispatch proxy with configured fault injection (call-counted)."""
+
+    def __init__(self, dispatch, config: ServingChaosConfig):
+        self._dispatch = dispatch
+        self.config = config
+        self.calls = 0
+
+    def __call__(self, x, mask, n_real):
+        i = self.calls
+        self.calls += 1
+        cfg = self.config
+        if i in cfg.slow_dispatch_steps:
+            time.sleep(cfg.slow_seconds)
+        if i in cfg.fail_dispatch_steps:
+            raise InjectedDispatchFault(
+                f"chaos: injected dispatch fault at dispatch {i}")
+        if cfg.poison_value is not None:
+            rows = np.asarray(x)
+            flat = rows.reshape(rows.shape[0], -1)
+            poisoned = np.all(flat == cfg.poison_value, axis=1)
+            if poisoned.any():
+                raise InjectedDispatchFault(
+                    f"chaos: poison row(s) {np.nonzero(poisoned)[0].tolist()} "
+                    f"in dispatch {i}")
+        return self._dispatch(x, mask, n_real)
+
+
+def chaos_dispatch(dispatch, config: ServingChaosConfig):
+    """Wrap a `MicroBatcher` dispatch function with deterministic fault
+    injection — install with
+    ``batcher._dispatch = chaos_dispatch(batcher._dispatch, cfg)`` (or on
+    `ServingEngine.batcher`).  The wrapper counts calls on ``.calls`` so
+    tests can assert how many device dispatches actually happened."""
+    return _ChaosDispatch(dispatch, config)

@@ -15,10 +15,17 @@ coalescing K concurrent *requests* per device dispatch.
   `[slots, max_len]` KV cache: finished sequences free their slot and
   queued prompts join mid-flight (`lm.py`);
 - `ServingMetrics` — queue depth, batch occupancy, p50/p95/p99 latency,
-  requests/s and tokens/s (`metrics.py`), surfaced via the UI server's
-  `GET /serving/stats`.
+  requests/s and tokens/s, plus the resilience ledger (`rejected`,
+  `shed`, `deadline_missed`, `poison_isolated`, `breaker_state`)
+  (`metrics.py`), surfaced via the UI server's `GET /serving/stats`;
+- serving-plane resilience (`resilience.py`, ISSUE-4): typed failures
+  (`ServingOverloadError` -> 503 + Retry-After, `DeadlineExceededError`
+  -> 504, `ServingUnavailableError` -> 503, `CircuitOpenError`) and the
+  `CircuitBreaker`; bounded admission, deadline shedding, poison-request
+  bisection and graceful drain are enforced in `batcher.py`/`lm.py`.
 
-See docs/performance.md (serving cost model) and docs/architecture.md.
+See docs/performance.md (serving cost model), docs/architecture.md and
+docs/robustness.md ("serving plane").
 """
 
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
@@ -30,13 +37,27 @@ from deeplearning4j_tpu.serving.bucketing import (
 from deeplearning4j_tpu.serving.engine import ServingEngine
 from deeplearning4j_tpu.serving.lm import ContinuousLMServer
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    ServingError,
+    ServingOverloadError,
+    ServingUnavailableError,
+)
 
 __all__ = [
     "BucketLadder",
+    "CircuitBreaker",
+    "CircuitOpenError",
     "ContinuousLMServer",
     "DEFAULT_BATCH_BUCKETS",
+    "DeadlineExceededError",
     "MicroBatcher",
     "ServingEngine",
+    "ServingError",
     "ServingMetrics",
+    "ServingOverloadError",
+    "ServingUnavailableError",
     "pow2_length_buckets",
 ]

@@ -24,6 +24,7 @@ import numpy as np
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
 from deeplearning4j_tpu.serving.bucketing import BucketLadder
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.resilience import CircuitBreaker
 
 
 class ServingEngine:
@@ -40,7 +41,11 @@ class ServingEngine:
                  max_batch: Optional[int] = None, max_wait_ms: float = 2.0,
                  metrics: Optional[ServingMetrics] = None,
                  max_programs: Optional[int] = None,
-                 input_dtype=np.float32):
+                 input_dtype=np.float32,
+                 max_queue_depth: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 breaker_threshold: Optional[int] = 5,
+                 breaker_cooldown_s: float = 1.0):
         self.net = net
         self.ladder = ladder if ladder is not None else BucketLadder()
         # every request is cast to ONE dtype (the one warmup() compiles)
@@ -55,11 +60,21 @@ class ServingEngine:
                              else self.ladder.program_bound)
         self._shape_lock = threading.Lock()
         self._seen_shapes = {}   # dtype str -> set of dispatch shapes
+        # serving-plane resilience (ISSUE-4): circuit breaker on the
+        # dispatch path, bounded admission + deadlines on the queue
+        self.breaker = (CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s,
+            on_transition=self.metrics.set_breaker_state)
+            if breaker_threshold else None)
         self.batcher = MicroBatcher(
             self._dispatch,
             max_batch=(max_batch if max_batch is not None
                        else self.ladder.max_batch),
-            max_wait_ms=max_wait_ms, metrics=self.metrics)
+            max_wait_ms=max_wait_ms, metrics=self.metrics,
+            max_queue_depth=max_queue_depth,
+            default_deadline_s=default_deadline_s,
+            breaker=self.breaker)
         if self.batcher.max_batch > self.ladder.max_batch:
             raise ValueError(
                 f"max_batch ({self.batcher.max_batch}) exceeds the "
@@ -108,20 +123,24 @@ class ServingEngine:
             return x, mask, t
         return x, None, None
 
-    def predict_proba(self, x, timeout: Optional[float] = None
-                      ) -> np.ndarray:
+    def predict_proba(self, x, timeout: Optional[float] = None,
+                      deadline_s: Optional[float] = None) -> np.ndarray:
         """[n, ...] features -> [n, classes] output activations (or
         [n, T, classes] for sequence-tagging outputs, sliced back to the
-        request's own T)."""
+        request's own T).  `deadline_s` rides the queue item so expired
+        work is shed before dispatch (docs/robustness.md)."""
         x, mask, t = self._prepare(x)
-        out = self.batcher.submit(x, mask, timeout=timeout)
+        out = self.batcher.submit(x, mask, timeout=timeout,
+                                  deadline_s=deadline_s)
         if t is not None and out.ndim == 3 and out.shape[1] != t:
             out = out[:, :t]       # drop the length-bucket padding steps
         return out
 
-    def predict(self, x, timeout: Optional[float] = None) -> np.ndarray:
+    def predict(self, x, timeout: Optional[float] = None,
+                deadline_s: Optional[float] = None) -> np.ndarray:
         """[n, ...] features -> [n] argmax class indices."""
-        return np.argmax(self.predict_proba(x, timeout=timeout), axis=-1)
+        return np.argmax(self.predict_proba(x, timeout=timeout,
+                                            deadline_s=deadline_s), axis=-1)
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -161,7 +180,30 @@ class ServingEngine:
             out["compiled_programs"] = sum(
                 len(s) for s in self._seen_shapes.values())
         out["program_bound"] = self.max_programs
+        out["accepting"] = self.accepting
         return out
+
+    @property
+    def accepting(self) -> bool:
+        """False once draining/stopped — the /readyz signal."""
+        return self.batcher._accepting
+
+    def ready(self) -> bool:
+        """Readiness for traffic: accepting admissions and the circuit
+        breaker is not open (docs/robustness.md serving lifecycle)."""
+        if not self.accepting:
+            return False
+        return self.breaker is None or self.breaker.state != "open"
+
+    def begin_drain(self) -> None:
+        """Stop admission; queued + in-flight requests still complete."""
+        self.batcher.begin_drain()
+
+    def drain(self, grace_s: float = 5.0) -> bool:
+        """Graceful shutdown: stop admission, let in-flight work finish
+        within `grace_s`, then stop the worker.  Returns True when the
+        queue fully drained."""
+        return self.batcher.drain(grace_s)
 
     def stop(self) -> None:
         self.batcher.stop()

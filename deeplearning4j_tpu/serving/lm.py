@@ -20,6 +20,14 @@ request's output does not depend on what shared its dispatches).
 top-k/top-p/beam requests take the legacy whole-sequence path in
 `ui/server.py` — their filters are static program variants, not per-slot
 switches.
+
+Resilience contract (ISSUE-4, mirrors `batcher.MicroBatcher`): bounded
+admission (`max_queue_depth` -> `ServingOverloadError`), per-request
+deadlines shed at the admitter before a prompt ever occupies a slot
+(`DeadlineExceededError`), an abandoned request's slot is freed so a
+timed-out client stops costing decode steps, an optional circuit
+breaker fast-fails admission after consecutive step failures, and
+`begin_drain()`/`drain()` implement the SIGTERM grace window.
 """
 
 from __future__ import annotations
@@ -32,6 +40,13 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    ServingUnavailableError,
+    check_admission,
+)
 
 
 def validate_request(cfg, prompt_ids, max_new_tokens: int) -> List[int]:
@@ -60,10 +75,10 @@ def validate_request(cfg, prompt_ids, max_new_tokens: int) -> List[int]:
 
 class _LMRequest:
     __slots__ = ("prompt", "max_new", "temperature", "seed", "event",
-                 "result", "error", "enqueued")
+                 "result", "error", "enqueued", "deadline", "abandoned")
 
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
-                 seed: int):
+                 seed: int, deadline: Optional[float] = None):
         self.prompt = list(prompt)
         self.max_new = int(max_new)
         self.temperature = float(temperature)
@@ -72,6 +87,8 @@ class _LMRequest:
         self.result: Optional[List[int]] = None
         self.error: Optional[BaseException] = None
         self.enqueued = time.perf_counter()
+        self.deadline = deadline   # absolute perf_counter time, or None
+        self.abandoned = False     # client gave up waiting
 
 
 class _Slot:
@@ -97,16 +114,29 @@ class ContinuousLMServer:
     """
 
     def __init__(self, cfg, params, slots: int = 4,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 max_queue_depth: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_queue_depth is not None and max_queue_depth < 1:
+            raise ValueError(f"max_queue_depth must be >= 1 or None, got "
+                             f"{max_queue_depth}")
         self.cfg = cfg
         self.params = params
         self.n_slots = int(slots)
+        self.max_queue_depth = max_queue_depth
+        self.default_deadline_s = default_deadline_s
+        self.breaker = breaker
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        if breaker is not None:
+            breaker.add_listener(self.metrics.set_breaker_state)
+            self.metrics.set_breaker_state(breaker.state)
         self._queue = collections.deque()
         self._cond = threading.Condition()
         self._running = False
+        self._accepting = True
         self._thread: Optional[threading.Thread] = None
         self._cache = None    # lazy: (k, v) device buffers
         self._step = None
@@ -119,18 +149,39 @@ class ContinuousLMServer:
         """`validate_request` against this server's config."""
         return validate_request(self.cfg, prompt_ids, max_new_tokens)
 
+    def _retry_after_locked(self) -> float:
+        lat = self.metrics.latency.summary()
+        per_req = (lat.get("p50_ms", 100.0) or 100.0) / 1e3
+        return max(0.1, per_req * (1 + len(self._queue) / self.n_slots))
+
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
-                 timeout: Optional[float] = None) -> List[int]:
-        """prompt ids -> full sequence (prompt + generated), blocking."""
+                 timeout: Optional[float] = None,
+                 deadline_s: Optional[float] = None) -> List[int]:
+        """prompt ids -> full sequence (prompt + generated), blocking.
+
+        `timeout` bounds the client's wait; `deadline_s` (default
+        `default_deadline_s`) rides the queue item so the admitter sheds
+        the request once it expires instead of spending decode steps on
+        a client that already gave up."""
         ids = self.validate(prompt_ids, max_new_tokens)
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         # fold into int32 range (the device-side PRNGKey seed dtype) so a
         # huge client seed cannot overflow the worker's seed vector
         seed = int(seed) & 0x7FFFFFFF
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
         req = _LMRequest(ids, int(max_new_tokens), temperature, seed)
+        if deadline_s is not None:
+            req.deadline = req.enqueued + float(deadline_s)
         with self._cond:
+            check_admission(
+                accepting=self._accepting, breaker=self.breaker,
+                queue_depth=len(self._queue),
+                max_queue_depth=self.max_queue_depth,
+                metrics=self.metrics,
+                retry_after_s=self._retry_after_locked, what="LM")
             if not self._running:
                 self._start_locked()
             self._queue.append(req)
@@ -140,14 +191,33 @@ class ContinuousLMServer:
             # Cancel rather than abandon (mirror of MicroBatcher.submit):
             # a still-queued request is removed so retry-on-timeout
             # clients cannot fill the pool with zombie decodes; one
-            # already in a slot is in flight and cannot be recalled.
+            # already in a slot is MARKED abandoned and the worker frees
+            # the slot at its next admit round (slot state is written by
+            # the worker thread ONLY — freeing it here would race the
+            # lock-free step-input build in `_drain_step`).
+            now = time.perf_counter()
             with self._cond:
                 try:
                     self._queue.remove(req)
                     self.metrics.set_queue_depth(len(self._queue))
+                    self.metrics.record_shed()
                 except ValueError:
-                    pass  # already admitted to a slot
-            raise TimeoutError(f"LM request timed out after {timeout}s")
+                    req.abandoned = True
+                    # a request the worker already RESOLVED needs no shed
+                    # here: a completed result was counted as a served
+                    # request at fold time, and a worker-shed error was
+                    # counted when it was shed; an in-slot request is
+                    # shed by the admitter when it frees the slot
+                resolved_with_error = (req.event.is_set()
+                                       and req.error is not None)
+            if (req.deadline is not None and now >= req.deadline
+                    and not resolved_with_error):
+                # count a deadline miss only when the server-side
+                # deadline actually expired and the worker has not
+                # already accounted it (mirror of MicroBatcher.submit)
+                self.metrics.record_deadline_missed()
+            raise DeadlineExceededError(
+                f"LM request timed out after {timeout}s")
         if req.error is not None:
             raise req.error
         return req.result
@@ -162,9 +232,54 @@ class ContinuousLMServer:
         with self._cond:
             leftovers = list(self._queue)
             self._queue.clear()
+            self.metrics.set_queue_depth(0)
         for req in leftovers:
-            req.error = RuntimeError("LM server stopped")
+            self.metrics.record_shed()
+            req.error = ServingUnavailableError("LM server stopped")
             req.event.set()
+
+    # ---- drain lifecycle --------------------------------------------------
+
+    @property
+    def accepting(self) -> bool:
+        """False once draining — the /readyz signal."""
+        with self._cond:
+            return self._accepting
+
+    def ready(self) -> bool:
+        """Readiness for traffic: accepting admissions and the circuit
+        breaker is not open (docs/robustness.md serving lifecycle)."""
+        if not self.accepting:
+            return False
+        return self.breaker is None or self.breaker.state != "open"
+
+    def begin_drain(self) -> None:
+        """Stop admission: subsequent generates raise
+        `ServingUnavailableError`; queued + in-slot work still decodes."""
+        with self._cond:
+            self._accepting = False
+            self._cond.notify_all()
+
+    def drain(self, grace_s: float = 5.0) -> bool:
+        """Stop admission, wait up to `grace_s` for queued + in-slot
+        requests to finish, then stop the worker.  Returns True when
+        everything drained within the grace window."""
+        self.begin_drain()
+        deadline = time.perf_counter() + max(0.0, grace_s)
+        while True:
+            with self._cond:
+                busy = bool(self._queue) or any(
+                    s.active for s in self._slots)
+            if not busy:
+                break
+            if time.perf_counter() >= deadline:
+                break
+            time.sleep(0.01)
+        with self._cond:
+            drained = not self._queue and not any(
+                s.active for s in self._slots)
+        self.stop()
+        return drained
 
     def stats(self) -> Dict:
         out = self.metrics.snapshot()
@@ -173,6 +288,7 @@ class ContinuousLMServer:
             out["active_slots"] = sum(s.active for s in self._slots)
             out["queue_depth"] = len(self._queue)
             out["decode_steps"] = self._steps
+            out["accepting"] = self._accepting
         out["max_len"] = self.cfg.max_len
         out["compiled_programs"] = 1  # one slot program per config
         return out
@@ -205,10 +321,37 @@ class ContinuousLMServer:
     def _admit_locked(self) -> None:
         """Queued prompts join free slots; the slot restarts at position
         0 — stale KV beyond a slot's position is masked, so no reset of
-        the cache buffers is needed."""
+        the cache buffers is needed.  Doomed work is shed first: an
+        abandoned request's slot is freed (its client gave up — further
+        decode steps are wasted device time; slot state is worker-owned,
+        so this is the one safe place to free it), and an expired or
+        abandoned queue item must never occupy a slot.  The queue sweep
+        is one rebuild pass — per-item `deque.remove` would be O(n^2)
+        under exactly the overload storm it exists for."""
+        for slot in self._slots:
+            if slot.active and slot.req.abandoned:
+                self.metrics.record_shed()
+                slot.req = None
+        now = time.perf_counter()
+        kept, shed = collections.deque(), 0
+        for req in self._queue:
+            if req.abandoned:
+                shed += 1
+            elif req.deadline is not None and now >= req.deadline:
+                shed += 1
+                self.metrics.record_deadline_missed()
+                req.error = DeadlineExceededError(
+                    f"deadline exceeded after {now - req.enqueued:.3f}s "
+                    f"in LM queue; shed before decode")
+                req.event.set()
+            else:
+                kept.append(req)
+        if shed:
+            self._queue = kept
+            self.metrics.record_shed(shed)
         for slot in self._slots:
             if not self._queue:
-                return
+                break
             if slot.active:
                 continue
             slot.req = self._queue.popleft()
@@ -217,7 +360,7 @@ class ContinuousLMServer:
             slot.generated = []
         self.metrics.set_queue_depth(len(self._queue))
 
-    def _drain(self) -> bool:
+    def _drain_step(self) -> bool:
         """One scheduling round: admit, build the step inputs, dispatch,
         fold the sampled tokens back into each lane.  Returns False when
         idle (nothing active, nothing queued)."""
@@ -226,6 +369,26 @@ class ContinuousLMServer:
             active = [s for s in self._slots if s.active]
             if not active:
                 return False
+        if self.breaker is not None and not self.breaker.allow_dispatch():
+            # open breaker: fast-fail whatever is in flight rather than
+            # burning decode steps on a failing device
+            err = CircuitOpenError(
+                "circuit breaker open: decode fast-failed",
+                retry_after_s=self.breaker.retry_after_s())
+            with self._cond:
+                for s in self._slots:
+                    if s.active:
+                        self.metrics.record_shed()
+                        s.req.error = err
+                        s.req.event.set()
+                        s.req = None
+            return True
+        if self._cache is None:
+            # a failed step consumed its donated k/v buffers and set the
+            # cache aside; rebuild INSIDE the protected loop so a failing
+            # rebuild fails this round's requests instead of killing the
+            # worker thread (slots restart at pos 0 — no state to keep)
+            self._reset_cache()
         token = np.zeros((self.n_slots,), np.int32)
         pos = np.zeros((self.n_slots,), np.int32)
         temp = np.zeros((self.n_slots,), np.float32)
@@ -245,6 +408,8 @@ class ContinuousLMServer:
             counts[i] = len(slot.generated)
         nxt, k, v = self._step(self.params, *self._cache, pos, token,
                                temp, seeds, counts)
+        if self.breaker is not None:
+            self.breaker.record_success()
         self._cache = (k, v)
         nxt = np.asarray(nxt)
         self._steps += 1
@@ -261,10 +426,16 @@ class ContinuousLMServer:
             slot.generated.append(int(nxt[i]))
             emitted += 1
             if len(slot.generated) >= slot.req.max_new:
-                slot.req.result = slot.req.prompt + slot.generated
-                self.metrics.record_request(
-                    time.perf_counter() - slot.req.enqueued)
-                slot.req.event.set()
+                if slot.req.abandoned:
+                    # the client timed out mid-decode and already got
+                    # DeadlineExceededError: the finished sequence is
+                    # discarded work, not a served request
+                    self.metrics.record_shed()
+                else:
+                    slot.req.result = slot.req.prompt + slot.generated
+                    self.metrics.record_request(
+                        time.perf_counter() - slot.req.enqueued)
+                    slot.req.event.set()
                 slot.req = None
         self.metrics.record_dispatch(len(active), self.n_slots)
         if emitted:
@@ -283,12 +454,16 @@ class ContinuousLMServer:
                         s.req = None
                     self._queue.clear()
                     for r in victims:
-                        r.error = RuntimeError("LM server stopped")
+                        self.metrics.record_shed()
+                        r.error = ServingUnavailableError(
+                            "LM server stopped")
                         r.event.set()
                     return
             try:
-                busy = self._drain()
+                busy = self._drain_step()
             except BaseException as e:  # noqa: BLE001 — fail in-flight, keep serving
+                if self.breaker is not None:
+                    self.breaker.record_failure()
                 with self._cond:
                     victims = [s for s in self._slots if s.active]
                     for s in victims:
@@ -296,12 +471,10 @@ class ContinuousLMServer:
                         s.req.event.set()
                         s.req = None
                 # the failed step may have consumed its donated k/v
-                # buffers; rebuild so later requests get a live cache
-                # (their slots restart at pos 0 — no state to preserve)
-                try:
-                    self._reset_cache()
-                except BaseException:  # noqa: BLE001 — device truly gone
-                    pass
+                # buffers; mark the cache dead so the next round rebuilds
+                # it inside this same protected loop (a rebuild that
+                # throws then fails THAT round's requests, not the worker)
+                self._cache = None
                 busy = True
             if not busy:
                 with self._cond:

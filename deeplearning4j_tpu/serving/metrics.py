@@ -32,6 +32,14 @@ class ServingMetrics:
         self._queue_depth = 0
         self._max_occupancy = 0
         self._started: Optional[float] = None
+        # resilience counters (ISSUE-4): the admission/shedding ledger —
+        # submitted == requests + rejected + shed + other-errors
+        self._rejected = 0         # refused at admission (overload/breaker)
+        self._shed = 0             # removed from a queue before dispatch
+        self._deadline_missed = 0  # failed because the deadline passed
+        self._poison_isolated = 0  # requests isolated as poison by bisection
+        self._breaker_state = "closed"
+        self._breaker_opens = 0
 
     # ---- recording --------------------------------------------------------
 
@@ -65,6 +73,32 @@ class ServingMetrics:
         with self._lock:
             self._queue_depth = int(depth)
 
+    def record_rejected(self, n: int = 1) -> None:
+        with self._lock:
+            self._touch()
+            self._rejected += int(n)
+
+    def record_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self._touch()
+            self._shed += int(n)
+
+    def record_deadline_missed(self, n: int = 1) -> None:
+        with self._lock:
+            self._touch()
+            self._deadline_missed += int(n)
+
+    def record_poison_isolated(self, n: int = 1) -> None:
+        with self._lock:
+            self._touch()
+            self._poison_isolated += int(n)
+
+    def set_breaker_state(self, state: str) -> None:
+        with self._lock:
+            if state == "open" and self._breaker_state != "open":
+                self._breaker_opens += 1
+            self._breaker_state = str(state)
+
     # ---- reading ----------------------------------------------------------
 
     @property
@@ -86,11 +120,22 @@ class ServingMetrics:
             rows, padded = self._rows, self._padded_rows
             tokens, depth = self._tokens, self._queue_depth
             max_occ = self._max_occupancy
+            rejected, shed = self._rejected, self._shed
+            deadline_missed = self._deadline_missed
+            poison = self._poison_isolated
+            breaker_state = self._breaker_state
+            breaker_opens = self._breaker_opens
         out = {
             "requests": requests,
             "dispatches": dispatches,
             "rows": rows,
             "queue_depth": depth,
+            "rejected": rejected,
+            "shed": shed,
+            "deadline_missed": deadline_missed,
+            "poison_isolated": poison,
+            "breaker_state": breaker_state,
+            "breaker_opens": breaker_opens,
             "latency": self.latency.summary(),
         }
         if dispatches:

@@ -1,0 +1,240 @@
+"""Serving-plane resilience: typed failures and the circuit breaker.
+
+The training plane got its fault-tolerance layer in the resilience
+subsystem (supervisor, retry, chaos — docs/robustness.md); this module
+is the serving-side counterpart.  It owns the *taxonomy* — every way a
+request can fail for a reason that is not the client's payload gets a
+typed exception the HTTP layer can map to the right status code — and
+the circuit breaker that turns "the device is failing every dispatch"
+into fast 503s instead of a queue full of doomed work.
+
+The enforcement sites live where the queues live (`batcher.py`,
+`lm.py`): bounded admission, deadline shedding before dispatch, and
+poison-request bisection.  This module stays import-light (stdlib only)
+so the exception types are usable from the HTTP layer without pulling
+in numpy/jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-plane failures that are the *server's*
+    condition, not the request payload (those stay ValueError -> 400)."""
+
+
+class ServingOverloadError(ServingError):
+    """Admission refused: the queue is at `max_queue_depth`.  Maps to
+    HTTP 503 with a `Retry-After` hint — the client should back off,
+    not the server buffer unboundedly."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class CircuitOpenError(ServingOverloadError):
+    """Admission refused because the circuit breaker is open: recent
+    dispatches failed wholesale, so queueing more work only builds a
+    backlog of doomed requests.  503 + Retry-After(remaining cooldown)."""
+
+
+class ServingUnavailableError(ServingError):
+    """The serving worker is stopped or draining — the request was (or
+    would be) abandoned without dispatch.  503: a load balancer should
+    route elsewhere; this replaces the untyped ``RuntimeError("batcher
+    stopped")`` that used to surface as a 500."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class DeadlineExceededError(ServingError, TimeoutError):
+    """The request's deadline passed before (or while) it could be
+    served; expired work is shed *before* dispatch so timed-out clients
+    stop costing device time.  Subclasses TimeoutError so existing
+    ``except TimeoutError`` clients keep working; HTTP maps it to 504."""
+
+
+# Breaker states (the closed vocabulary /serving/stats and tests use):
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker for the dispatch path.
+
+    - CLOSED: dispatches flow; `failure_threshold` *consecutive*
+      whole-dispatch failures trip it OPEN.
+    - OPEN: admission fast-fails (`CircuitOpenError`) and `/readyz`
+      reports not-ready; after `cooldown_s` the next dispatch attempt
+      is admitted as the half-open probe.
+    - HALF_OPEN: exactly one probe dispatch is in flight; its success
+      closes the breaker, its failure re-opens it (fresh cooldown).
+
+    Thread-safe; `clock` is injectable so tests drive the cooldown
+    without wall-clock sleeps.  `on_transition(state)` fires on every
+    state change (the metrics hook).
+    """
+
+    def __init__(self, failure_threshold: int = 5, cooldown_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Optional[Callable[[str], None]] = None):
+        if failure_threshold < 1:
+            raise ValueError(f"failure_threshold must be >= 1, got "
+                             f"{failure_threshold}")
+        if cooldown_s < 0:
+            raise ValueError(f"cooldown_s must be >= 0, got {cooldown_s}")
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._listeners = [] if on_transition is None else [on_transition]
+        self._lock = threading.Lock()
+        self._state = BREAKER_CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self._opens = 0
+
+    # ---- internal ---------------------------------------------------------
+
+    def add_listener(self, fn: Callable[[str], None]) -> None:
+        """Subscribe to state transitions (idempotent per callable) —
+        how the serving metrics mirror `breaker_state` without claiming
+        exclusive ownership of a caller-supplied breaker."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def _set_state_locked(self, state: str) -> None:
+        if state == self._state:
+            return
+        self._state = state
+        if state == BREAKER_OPEN:
+            self._opens += 1
+        for fn in self._listeners:
+            fn(state)
+
+    # ---- reading ----------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            # the cooldown elapsing IS the open -> half-open transition;
+            # commit it here (firing on_transition) so readiness and the
+            # stats ledger agree without waiting for the next dispatch
+            if (self._state == BREAKER_OPEN
+                    and self._clock() - self._opened_at >= self.cooldown_s):
+                self._set_state_locked(BREAKER_HALF_OPEN)
+            return self._state
+
+    @property
+    def opens(self) -> int:
+        """How many times the breaker has tripped open (monotonic)."""
+        with self._lock:
+            return self._opens
+
+    def retry_after_s(self) -> float:
+        """Remaining cooldown (>= a small floor) — the Retry-After hint."""
+        with self._lock:
+            if self._state != BREAKER_OPEN:
+                return 0.05
+            remaining = self.cooldown_s - (self._clock() - self._opened_at)
+            return max(0.05, remaining)
+
+    # ---- admission / dispatch gates ---------------------------------------
+
+    def rejecting(self) -> bool:
+        """True while admission should fast-fail: OPEN inside the
+        cooldown window.  After the cooldown, admission resumes so a
+        queued request can become the half-open probe."""
+        with self._lock:
+            return (self._state == BREAKER_OPEN
+                    and self._clock() - self._opened_at < self.cooldown_s)
+
+    def allow_dispatch(self) -> bool:
+        """Gate one dispatch attempt.  CLOSED: always.  OPEN: only once
+        the cooldown elapsed, transitioning to HALF_OPEN and claiming
+        the probe.  HALF_OPEN: only if no probe is already in flight."""
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return True
+            if self._state == BREAKER_OPEN:
+                if self._clock() - self._opened_at < self.cooldown_s:
+                    return False
+                self._set_state_locked(BREAKER_HALF_OPEN)
+                self._probe_in_flight = True
+                return True
+            # HALF_OPEN
+            if self._probe_in_flight:
+                return False
+            self._probe_in_flight = True
+            return True
+
+    # ---- outcome recording ------------------------------------------------
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._probe_in_flight = False
+            self._set_state_locked(BREAKER_CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            probe_failed = self._state == BREAKER_HALF_OPEN
+            if probe_failed or self._consecutive >= self.failure_threshold:
+                self._probe_in_flight = False
+                self._opened_at = self._clock()
+                # re-opening from HALF_OPEN must count as a fresh open
+                if self._state == BREAKER_HALF_OPEN:
+                    self._state = BREAKER_CLOSED  # force the transition
+                self._set_state_locked(BREAKER_OPEN)
+
+
+def check_admission(*, accepting: bool, breaker: Optional[CircuitBreaker],
+                    queue_depth: int, max_queue_depth: Optional[int],
+                    metrics, retry_after_s: Callable[[], float],
+                    what: str = "serving") -> None:
+    """THE admission gate, shared by `MicroBatcher.submit` and
+    `ContinuousLMServer.generate` (call with the owner's lock held).
+    Checks in blast-radius order — draining, breaker, queue bound —
+    raising the matching typed error and counting the rejection.
+    `retry_after_s` is a thunk so the backlog estimate is only computed
+    when a rejection actually happens."""
+    if not accepting:
+        metrics.record_rejected()
+        raise ServingUnavailableError(
+            f"{what} is draining: admission stopped")
+    if breaker is not None and breaker.rejecting():
+        metrics.record_rejected()
+        raise CircuitOpenError(
+            f"circuit breaker open: recent {what} dispatches failed "
+            f"wholesale; backing off",
+            retry_after_s=breaker.retry_after_s())
+    if max_queue_depth is not None and queue_depth >= max_queue_depth:
+        metrics.record_rejected()
+        raise ServingOverloadError(
+            f"{what} queue full ({queue_depth} >= max_queue_depth "
+            f"{max_queue_depth})",
+            retry_after_s=retry_after_s())
+
+
+__all__ = [
+    "BREAKER_CLOSED",
+    "BREAKER_HALF_OPEN",
+    "BREAKER_OPEN",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "DeadlineExceededError",
+    "ServingError",
+    "ServingOverloadError",
+    "ServingUnavailableError",
+    "check_admission",
+]

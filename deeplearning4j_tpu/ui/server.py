@@ -29,7 +29,28 @@ Endpoint parity with `UiServer.run():75-87`:
                               dynamic micro-batcher
 - GET  /serving/stats         serving metrics: queue depth, batch
                               occupancy, p50/p95/p99 latency, requests/s,
-                              tokens/s, compiled program counts
+                              tokens/s, compiled program counts, plus the
+                              resilience ledger (rejected/shed/
+                              deadline_missed/poison_isolated/
+                              breaker_state)
+- GET  /healthz               liveness: 200 while the process serves HTTP
+- GET  /readyz                readiness: 200 only while every registered
+                              serving plane is accepting admissions and
+                              no circuit breaker is open; 503 otherwise
+                              (drain flips this before traffic stops)
+
+Serving-plane failures are mapped to transport-correct statuses
+(ISSUE-4): ServingOverloadError/CircuitOpenError -> 503 with a
+Retry-After hint, ServingUnavailableError (stopped/draining) -> 503,
+DeadlineExceededError -> 504.  Requests may carry a deadline via the
+`deadline_ms` body field or `X-Deadline-Ms` header; expired work is
+shed before it reaches the device on the queued paths — the
+micro-batched /model/predict and the continuous /lm/generate pool.
+The whole-sequence LM legs (top-k/top-p/beam, or continuous=False)
+decode in one uninterruptible jitted scan: a deadline sent there is
+validated but not enforced mid-flight — the response simply arrives
+late.  Deadline-sensitive clients should use the greedy/temperature
+continuous path.
 
 All payloads are JSON. `port=0` picks a free port (tests).
 """
@@ -37,11 +58,18 @@ All payloads are JSON. `port=0` picks a free port (tests).
 from __future__ import annotations
 
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, List, Optional
 
 import numpy as np
+
+from deeplearning4j_tpu.serving.resilience import (
+    DeadlineExceededError,
+    ServingOverloadError,
+    ServingUnavailableError,
+)
 
 
 # Human-viewable dashboard (the reference served FreeMarker pages from the
@@ -136,6 +164,7 @@ class _UiState:
         self.lm = None  # (TransformerConfig, params) via serve_lm
         self.lm_server = None  # serving.ContinuousLMServer via serve_lm
         self.engine = None     # serving.ServingEngine via serve_model
+        self.draining = False  # set by UiServer.begin_drain (SIGTERM path)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -147,15 +176,37 @@ class _Handler(BaseHTTPRequestHandler):
     def state(self) -> _UiState:
         return self.server.ui_state  # type: ignore[attr-defined]
 
-    def _send(self, code: int, ctype: str, data: bytes) -> None:
+    def _send(self, code: int, ctype: str, data: bytes,
+              headers: Optional[dict] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(data)
 
-    def _json(self, code: int, payload: Any) -> None:
-        self._send(code, "application/json", json.dumps(payload).encode())
+    def _json(self, code: int, payload: Any,
+              headers: Optional[dict] = None) -> None:
+        self._send(code, "application/json", json.dumps(payload).encode(),
+                   headers=headers)
+
+    def _deadline_s(self, body: Any) -> Optional[float]:
+        """Per-request deadline from the `deadline_ms` body field or the
+        `X-Deadline-Ms` header (body wins); None = no deadline.  A
+        malformed value is a client error (ValueError -> 400)."""
+        raw = None
+        if isinstance(body, dict) and body.get("deadline_ms") is not None:
+            raw = body["deadline_ms"]
+        elif self.headers.get("X-Deadline-Ms"):
+            raw = self.headers["X-Deadline-Ms"]
+        if raw is None:
+            return None
+        ms = float(raw)
+        if not math.isfinite(ms) or ms <= 0:
+            raise ValueError(f"deadline_ms must be a positive finite "
+                             f"number of milliseconds, got {raw!r}")
+        return ms / 1e3
 
     def _body(self) -> Any:
         length = int(self.headers.get("Content-Length", 0))
@@ -171,6 +222,30 @@ class _Handler(BaseHTTPRequestHandler):
         s = self.state
         if self.path in ("/", "/index.html"):
             self._html(_DASHBOARD)
+            return
+        if self.path == "/healthz":
+            # liveness: answering at all is the signal
+            self._json(200, {"ok": True})
+            return
+        if self.path == "/readyz":
+            # readiness: every registered serving plane must be
+            # accepting admissions with its breaker not open; a drain
+            # flips this to 503 before traffic actually stops
+            with s.lock:
+                engine, lm_server = s.engine, s.lm_server
+                draining = s.draining
+            reasons = []
+            if draining:
+                reasons.append("draining")
+            if engine is not None and not engine.ready():
+                reasons.append("classifier engine not ready")
+            if lm_server is not None and not lm_server.ready():
+                reasons.append("lm server not ready")
+            if reasons:
+                self._json(503, {"ready": False, "reasons": reasons},
+                           headers={"Retry-After": 1})
+            else:
+                self._json(200, {"ready": True})
             return
         with s.lock:
             if self.path == "/api/coords":
@@ -207,6 +282,17 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             self._route_post(body)
+        except DeadlineExceededError as e:
+            # the request's deadline passed before it could be served
+            self._json(504, {"error": str(e)})
+        except (ServingOverloadError, ServingUnavailableError) as e:
+            # admission refused (queue full / breaker open / draining):
+            # 503 + Retry-After so well-behaved clients back off
+            retry_after = max(1, math.ceil(
+                getattr(e, "retry_after_s", 1.0)))
+            self._json(503, {"error": str(e),
+                             "retry_after_s": retry_after},
+                       headers={"Retry-After": retry_after})
         except Exception as e:  # noqa: BLE001 — surface as 400, keep serving
             self._json(400, {"error": repr(e)})
 
@@ -293,8 +379,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(400, {"error": "features required"})
                 return
             try:
+                deadline_s = self._deadline_s(body)
                 x = np.asarray(feats, np.float32)
-                probs = engine.predict_proba(x)
+                probs = engine.predict_proba(x, deadline_s=deadline_s)
             except (ValueError, TypeError) as e:
                 self._json(400, {"error": str(e)})
                 return
@@ -338,6 +425,7 @@ class _Handler(BaseHTTPRequestHandler):
             top_p = float(body.get("top_p", 1.0))
             # fold into int32 range: PRNGKey/device seed dtype
             seed = int(body.get("seed", 0)) & 0x7FFFFFFF
+            deadline_s = self._deadline_s(body)
             ids_list = validate_request(cfg, prompt, max_new)
             if temperature < 0:
                 raise ValueError(f"temperature must be >= 0, "
@@ -368,7 +456,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # whatever else is decoding right now
                 ids = lm_server.generate(ids_list, max_new,
                                          temperature=temperature,
-                                         seed=seed)
+                                         seed=seed, deadline_s=deadline_s)
                 self._json(200, {"ids": ids})
                 return
             import jax
@@ -404,16 +492,30 @@ class UiServer:
         return self._server.ui_state  # type: ignore[attr-defined]
 
     def serve_lm(self, cfg, params, slots: int = 4,
-                 continuous: bool = True) -> "UiServer":
+                 continuous: bool = True,
+                 max_queue_depth: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 breaker_threshold: Optional[int] = 5,
+                 breaker_cooldown_s: float = 1.0) -> "UiServer":
         """Register a TransformerLM for POST /lm/generate.  With
         `continuous` (default) greedy/temperature requests decode in a
         `slots`-lane continuous batching pool; `continuous=False` keeps
-        every request on the whole-sequence path."""
+        every request on the whole-sequence path.  `max_queue_depth`,
+        `default_deadline_s` and the breaker knobs configure the
+        serving-plane resilience layer (docs/robustness.md)."""
         lm_server = None
         if continuous:
-            from deeplearning4j_tpu.serving import ContinuousLMServer
+            from deeplearning4j_tpu.serving import (
+                CircuitBreaker,
+                ContinuousLMServer,
+            )
 
-            lm_server = ContinuousLMServer(cfg, params, slots=slots)
+            breaker = (CircuitBreaker(failure_threshold=breaker_threshold,
+                                      cooldown_s=breaker_cooldown_s)
+                       if breaker_threshold else None)
+            lm_server = ContinuousLMServer(
+                cfg, params, slots=slots, max_queue_depth=max_queue_depth,
+                default_deadline_s=default_deadline_s, breaker=breaker)
         with self.state.lock:
             self.state.lm = (cfg, params)
             old = self.state.lm_server
@@ -424,14 +526,24 @@ class UiServer:
 
     def serve_model(self, net, max_batch: int = 32,
                     max_wait_ms: float = 2.0, ladder=None,
-                    warmup_example=None) -> "UiServer":
+                    warmup_example=None,
+                    max_queue_depth: Optional[int] = None,
+                    default_deadline_s: Optional[float] = None,
+                    breaker_threshold: Optional[int] = 5,
+                    breaker_cooldown_s: float = 1.0) -> "UiServer":
         """Register a MultiLayerNetwork behind the dynamic micro-batcher
         for POST /model/predict.  `warmup_example` (one example row) pre-
-        compiles every bucket-ladder shape before traffic."""
+        compiles every bucket-ladder shape before traffic.
+        `max_queue_depth`, `default_deadline_s` and the breaker knobs
+        configure the serving-plane resilience layer."""
         from deeplearning4j_tpu.serving import ServingEngine
 
         engine = ServingEngine(net, ladder=ladder, max_batch=max_batch,
-                               max_wait_ms=max_wait_ms)
+                               max_wait_ms=max_wait_ms,
+                               max_queue_depth=max_queue_depth,
+                               default_deadline_s=default_deadline_s,
+                               breaker_threshold=breaker_threshold,
+                               breaker_cooldown_s=breaker_cooldown_s)
         if warmup_example is not None:
             engine.warmup(warmup_example)
         with self.state.lock:
@@ -444,6 +556,47 @@ class UiServer:
     def start(self) -> "UiServer":
         self._thread.start()
         return self
+
+    # ---- drain lifecycle (the `dl4j serve` SIGTERM path) ------------------
+
+    def serving_stats(self) -> dict:
+        """The /serving/stats payload, host-side (drain snapshots it)."""
+        with self.state.lock:
+            engine, lm_server = self.state.engine, self.state.lm_server
+        return {"classifier": engine.stats() if engine else None,
+                "lm": lm_server.stats() if lm_server else None}
+
+    def begin_drain(self) -> None:
+        """Stop admission on every registered serving plane: new
+        requests 503 and /readyz flips to not-ready, while queued and
+        in-flight work keeps running."""
+        with self.state.lock:
+            self.state.draining = True
+            engine, lm_server = self.state.engine, self.state.lm_server
+        if engine is not None:
+            engine.begin_drain()
+        if lm_server is not None:
+            lm_server.begin_drain()
+
+    def drain(self, grace_s: float = 5.0) -> bool:
+        """Graceful drain: stop admission, then give in-flight work up
+        to `grace_s` (total) to finish.  Returns True when every plane
+        fully drained.  The HTTP server keeps answering /healthz,
+        /readyz and /serving/stats throughout; call `stop()` after."""
+        self.begin_drain()
+        with self.state.lock:
+            engine, lm_server = self.state.engine, self.state.lm_server
+        import time as _time
+
+        deadline = _time.perf_counter() + max(0.0, grace_s)
+        drained = True
+        if engine is not None:
+            drained &= engine.drain(
+                max(0.0, deadline - _time.perf_counter()))
+        if lm_server is not None:
+            drained &= lm_server.drain(
+                max(0.0, deadline - _time.perf_counter()))
+        return drained
 
     def stop(self) -> None:
         self._server.shutdown()

@@ -26,6 +26,21 @@ import sys
 # narrowed or carry a justified `noqa: BLE001` pragma on the except line.
 ALLOWLIST: dict = {}
 
+# Under serving/ the bar is higher (ISSUE-4): the request path is where a
+# swallowed AttributeError becomes a silent wrong answer at scale, so a
+# `noqa: BLE001` pragma alone is NOT enough — every broad handler,
+# pragma'd or not, must be accounted for here with its exact ceiling.
+# The documented sites are the group-failure isolators (a dispatch group
+# / decode step must fail its OWN requests whatever the device raised)
+# and the worker-survival backstops (the worker thread must outlive any
+# group failure, or every future submit hangs on a dead queue).
+SERVING_ALLOWLIST: dict = {
+    "deeplearning4j_tpu/serving/batcher.py": 2,  # _execute bisector +
+                                                 # _run survival backstop
+    "deeplearning4j_tpu/serving/lm.py": 1,       # _run fail-in-flight
+}
+SERVING_PREFIX = "deeplearning4j_tpu/serving/"
+
 PACKAGE = "deeplearning4j_tpu"
 PRAGMA = "noqa: BLE001"
 
@@ -46,8 +61,11 @@ def _is_broad(handler: ast.ExceptHandler) -> bool:
     return broad_name(t)
 
 
-def broad_handlers(path: pathlib.Path):
-    """Yield (lineno, line) for each un-pragma'd broad handler in `path`."""
+def broad_handlers(path: pathlib.Path, respect_pragma: bool = True):
+    """Yield (lineno, line) for each broad handler in `path`.  With
+    `respect_pragma` (the default), handlers whose except line carries
+    the `noqa: BLE001` pragma are skipped; `respect_pragma=False` counts
+    EVERY broad handler — the serving/ strict mode."""
     source = path.read_text()
     lines = source.splitlines()
     try:
@@ -58,7 +76,7 @@ def broad_handlers(path: pathlib.Path):
     for node in ast.walk(tree):
         if isinstance(node, ast.ExceptHandler) and _is_broad(node):
             line = lines[node.lineno - 1]
-            if PRAGMA not in line:
+            if not respect_pragma or PRAGMA not in line:
                 yield (node.lineno, line.strip())
 
 
@@ -70,6 +88,21 @@ def main(argv=None) -> int:
     failures = []
     for path in sorted(pkg.rglob("*.py")):
         rel = str(path.relative_to(root))
+        if rel.startswith(SERVING_PREFIX):
+            # strict mode subsumes the relaxed pragma check: count EVERY
+            # broad handler (pragma'd or not) against the explicit
+            # serving allowlist ceiling, and report each offender once
+            every = list(broad_handlers(path, respect_pragma=False))
+            ceiling = SERVING_ALLOWLIST.get(rel, 0)
+            if len(every) > ceiling:
+                for lineno, line in every[ceiling:]:
+                    failures.append(
+                        f"{rel}:{lineno}: broad except handler under "
+                        f"serving/ exceeds the SERVING_ALLOWLIST ceiling "
+                        f"({ceiling}) — narrow it or (if it really is a "
+                        f"group-failure isolator) raise the ceiling with "
+                        f"a review: {line}")
+            continue
         found = list(broad_handlers(path))
         allowed = ALLOWLIST.get(rel, 0)
         if len(found) > allowed:

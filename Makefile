@@ -1,7 +1,8 @@
 # Convenience entry points. Everything here is reproducible by hand —
 # the targets just spell the one-liners out.
 
-.PHONY: test test-serving dryrun bench smoke serving-smoke evidence lint
+.PHONY: test test-serving test-precision dryrun bench smoke serving-smoke \
+	bench-precision evidence lint
 
 test:
 	python -m pytest tests/ -x -q
@@ -30,6 +31,16 @@ smoke:
 # + the overload/admission-control row).
 serving-smoke:
 	BENCH_ONLY=serving,servinglm,servingoverload python bench.py
+
+# Precision-plane tests only (bf16-mixed parity/determinism, loss-scaler
+# overflow recovery, int8 serving agreement, dtype round-trips).
+test-precision:
+	python -m pytest tests/ -q -m precision
+
+# Precision-plane bench row: bf16-mixed train-state reduction, int8
+# param-bytes reduction, parity guards (docs/performance.md).
+bench-precision:
+	BENCH_ONLY=precision python bench.py
 
 # Regenerate every committed EVIDENCE/ artifact (see EVIDENCE/README.md).
 # Each runner re-execs itself into a scrubbed 8-virtual-CPU-device env,

@@ -137,6 +137,40 @@ def _time_fused_steps(net, x, y, steps: int) -> tuple:
     return float(np.median(times)), syncs
 
 
+def _mem_fields(net=None, x=None, params=None, updater_state=None,
+                compute_dtype: str = "float32",
+                inference: bool = False) -> dict:
+    """param_bytes / train_state_bytes columns (ISSUE-5): every row
+    carries the memory trajectory so BENCH_*.json tracks it release
+    over release.  `net` path uses the net's precision policy (and an
+    example batch for the activation term); `params` path covers the
+    raw-pytree transformer rows.  `inference=True` rows (e.g. KV
+    decode) hold no gradients/optimizer state, so train_state_bytes is
+    None rather than a fabricated training-memory model."""
+    import jax
+
+    from deeplearning4j_tpu.precision import (
+        param_bytes,
+        train_state_bytes,
+        tree_bytes,
+    )
+
+    if net is not None:
+        return {"param_bytes": int(param_bytes(net)),
+                "train_state_bytes": int(train_state_bytes(net, x))}
+    if inference:
+        return {"param_bytes": int(tree_bytes(params)),
+                "train_state_bytes": None}
+    n = sum(int(np.prod(np.shape(a)))
+            for a in jax.tree_util.tree_leaves(params))
+    total = tree_bytes(params)
+    if updater_state is not None:
+        total += tree_bytes(updater_state)
+    total += n * np.dtype(compute_dtype).itemsize  # gradient term
+    return {"param_bytes": int(tree_bytes(params)),
+            "train_state_bytes": int(total)}
+
+
 def _fused_fields(sec_fused: float, sec_unfused: float, syncs: int,
                   steps: int) -> dict:
     """Shared row fields for the fused-vs-unfused before/after story."""
@@ -206,6 +240,7 @@ def bench_lenet() -> dict:
             "fused_examples_per_sec": round(BATCH / sec_fused, 1),
             "unfused_examples_per_sec": round(BATCH / sec_unfused, 1),
             **_fused_fields(sec_fused, sec_unfused, syncs, STEPS),
+            **_mem_fields(net=net_f, x=np.asarray(x)),
             "mfu": round(flops / sec / _peak_flops(on_tpu), 5)}
 
 
@@ -239,7 +274,8 @@ def bench_iris() -> dict:
                        else "per-step"),
               "fused_examples_per_sec": round(len(x) / sec_fused, 1),
               "unfused_examples_per_sec": round(len(x) / sec_unfused, 1),
-              **_fused_fields(sec_fused, sec_unfused, syncs, steps)}
+              **_fused_fields(sec_fused, sec_unfused, syncs, steps),
+              **_mem_fields(net=net_f, x=np.asarray(x))}
     try:  # end-to-end CLI entrypoint (includes IO + eval + save)
         from deeplearning4j_tpu.cli import main as cli_main
 
@@ -327,6 +363,7 @@ def bench_lstm() -> dict:
             "unit": "examples/sec", "value": round(B / sec, 1),
             "batch": B, "seq_len": T, "dtype": dtype,
             "step_ms": round(sec * 1e3, 3),
+            **_mem_fields(net=net_c, x=np.asarray(x)),
             "mfu": round(flops / sec / _peak_flops(on_tpu), 5), **result}
 
 
@@ -385,6 +422,11 @@ def bench_word2vec() -> dict:
     sec = time.perf_counter() - t0
     return {"metric": "Word2Vec words/sec", "unit": "words/sec",
             "value": round(n_tokens / sec, 1), "tokens": n_tokens,
+            "param_bytes": sum(
+                int(np.prod(np.shape(t))) * np.asarray(t).dtype.itemsize
+                for t in (w2v.syn0, w2v.syn1, w2v.syn1neg)
+                if t is not None) or None,
+            "train_state_bytes": None,
             "devices": n_dev, "corpus": corpus,
             "timing": "steady-state (post-compile)",
             "host_overlap": ("pair-gen runs on a background producer "
@@ -424,6 +466,8 @@ def bench_scaling() -> dict:
         sec = _time_steps(lambda: fit(x, y), WARMUP, max(30, STEPS // 2))
         return b / sec
 
+    mem = _mem_fields(
+        net=MultiLayerNetwork(alexnet_cifar10(compute_dtype=dtype)).init())
     one = throughput(1)
     if n < 2:
         # No multi-chip hardware: still emit a NUMBER — the same 1-vs-8
@@ -439,7 +483,7 @@ def bench_scaling() -> dict:
                # contention noise by design (8 virtual devices share one
                # host's cores): a CHECK, not a perf metric — exempt from
                # pinning and the regression guard
-               "no_pin": True,
+               "no_pin": True, **mem,
                "one_chip_examples_per_sec": round(one, 1),
                "note": f"only {n} real device(s); real-ICI efficiency "
                        f"needs hardware"}
@@ -466,7 +510,7 @@ def bench_scaling() -> dict:
         return row
     many = throughput(n)
     return {"metric": f"AlexNet-CIFAR10 DP scaling efficiency 1->{n}",
-            "unit": "fraction",
+            "unit": "fraction", **mem,
             "value": round(many / (n * one), 4),
             "one_chip_examples_per_sec": round(one, 1),
             f"{n}_chip_examples_per_sec": round(many, 1)}
@@ -554,6 +598,8 @@ def bench_transformer() -> dict:
     mfu = flops / sec / peak
     row = {"metric": f"TransformerLM train tokens/sec/chip (B{B}xS{S})",
            "unit": "tokens/sec", "value": round(B * S / sec, 1),
+           **_mem_fields(params=state["p"],
+                         compute_dtype="bfloat16" if on_tpu else "float32"),
            "mfu": round(mfu, 4), "params": n_params,
            "batch": B, "seq_len": S,
            "dtype": ("bf16-compute/f32-master" if on_tpu else cfg.dtype)}
@@ -601,6 +647,8 @@ def bench_flash_ab() -> dict:
     os.environ.pop("DL4J_TPU_FLASH_BWD", None)
     return {"metric": "flash-bwd vs dense-bwd speedup @S=1024",
             "unit": "ratio", "value": round(dense / fused, 3),
+            "param_bytes": None, "train_state_bytes": None,
+            "mem_note": "kernel row: qkv operands only, no resident params",
             "fused_ms": round(fused * 1e3, 2),
             "dense_ms": round(dense * 1e3, 2)}
 
@@ -661,6 +709,8 @@ def bench_gpt2() -> dict:
                  "tpu-gated)")
     row = {"metric": name, "unit": "tokens/sec",
            "value": round(b_global * S / sec, 1), "params": n_params,
+           **_mem_fields(params=state["p"], updater_state=state["o"],
+                         compute_dtype="bfloat16" if on_tpu else "float32"),
            "batch": b_global, "seq_len": S, "accum": accum,
            "step_ms": round(sec * 1e3, 1), "mfu": round(mfu, 4),
            "remat": cfg.remat, "tied_embeddings": cfg.tie_embeddings,
@@ -713,6 +763,7 @@ def bench_decode() -> dict:
                  "tpu-gated)")
     return {"metric": name, "unit": "tokens/sec",
             "value": round(b * new / sec, 1), "batch": b,
+            **_mem_fields(params=params, inference=True),
             "new_tokens": new, "prompt_len": 8,
             "ms_per_token": round(sec / new * 1e3, 3),
             "params": sum(int(np.prod(np.shape(x)))
@@ -751,6 +802,8 @@ def bench_longctx() -> dict:
                       max(20, STEPS // 5))
     return {"metric": "flash-attn fwd+bwd tokens/sec @S=16384",
             "unit": "tokens/sec", "value": round(Bq * Sq / sec, 1),
+            "param_bytes": None, "train_state_bytes": None,
+            "mem_note": "kernel row: qkv operands only, no resident params",
             "step_ms": round(sec * 1e3, 2), "batch": Bq, "heads": Hq,
             "head_dim": Dq, "dtype": "bfloat16"}
 
@@ -806,6 +859,8 @@ def bench_gpt2_mem() -> dict:
     return {"metric": "GPT2-small 124M full-size train step "
                       "(B8xS1024,accum4,remat,adam)",
             "unit": "tokens/sec", "value": round(b_global * 1024 / steady_s, 1),
+            **_mem_fields(params=state["p"], updater_state=state["o"],
+                          compute_dtype="bfloat16"),
             "params": n_params, "losses": [round(v, 4) for v in losses],
             "step_s": round(steady_s, 1), "first_step_s": round(first_s, 1),
             "peak_rss_gib": round(peak_gib, 2),
@@ -813,6 +868,127 @@ def bench_gpt2_mem() -> dict:
             "accum": accum, "tied_embeddings": cfg.tie_embeddings,
             "note": "memory-path proof: OOM, not speed, is the question "
                     "this row answers off-TPU"}
+
+
+def bench_precision() -> dict:
+    """Precision-plane row (ISSUE-5 acceptance): the memory/parity
+    story of bf16-mixed training and int8 weight-quantized serving.
+
+    - TRAIN leg: LeNet @ BATCH fp32 vs mixed — step time and the
+      train-state-bytes model (fp32 masters + bf16 grads/activations);
+      the acceptance bar is >=1.9x reduction.
+    - PARITY leg: iris + lenet final-loss gap, bf16-mixed vs fp32,
+      within the documented tolerance (docs/performance.md).
+    - SERVING leg: `mnist_mlp` int8 vs fp32 — resident param bytes
+      (>=3.5x bar), top-1 agreement (>=99% bar) and batched-forward
+      latency for both.
+    """
+    import jax
+
+    from deeplearning4j_tpu.models import (
+        MultiLayerNetwork,
+        lenet_mnist,
+        mnist_mlp,
+    )
+    from deeplearning4j_tpu.models.zoo import iris_mlp
+    from deeplearning4j_tpu.precision import (
+        QuantizedNet,
+        param_bytes,
+        train_state_bytes,
+    )
+    from deeplearning4j_tpu.serving import BucketLadder
+
+    rng = np.random.default_rng(0)
+    steps = max(20, STEPS // 5)
+
+    # ---- train leg: lenet fp32 vs mixed --------------------------------
+    x, y = _staged(rng.random((BATCH, 28, 28, 1), dtype=np.float32),
+                   np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)])
+    legs = {}
+    for name in ("fp32", "mixed"):
+        net = MultiLayerNetwork(lenet_mnist(updater="sgd")).init()
+        net.set_precision(name)
+        sec = _time_steps(lambda: net.fit_batch_async(x, y), WARMUP, steps)
+        legs[name] = {
+            "examples_per_sec": round(BATCH / sec, 1),
+            "step_ms": round(sec * 1e3, 3),
+            "train_state_bytes": int(train_state_bytes(net, np.asarray(x))),
+        }
+    mem_reduction = (legs["fp32"]["train_state_bytes"]
+                     / legs["mixed"]["train_state_bytes"])
+
+    # ---- parity leg: final-loss gap on iris + lenet --------------------
+    ix = rng.normal(0, 0.25, (96, 4)).astype(np.float32)
+    iy = rng.integers(0, 3, 96)
+    ix += iy[:, None]
+    iyh = np.eye(3, dtype=np.float32)[iy]
+    parity = {}
+    for row_name, conf, (px, py), n_steps, tol in (
+            ("iris", iris_mlp(), (ix, iyh), 120, 0.05),
+            ("lenet", lenet_mnist(updater="sgd"),
+             (np.asarray(x)[:64], np.asarray(y)[:64]), 25, 0.1)):
+        finals = {}
+        for pol in ("fp32", "mixed"):
+            net = MultiLayerNetwork(conf).init()
+            net.set_precision(pol)
+            for _ in range(n_steps):
+                loss = net.fit_batch_async(px, py)
+            finals[pol] = float(loss)
+        gap = abs(finals["fp32"] - finals["mixed"])
+        parity[row_name] = {
+            "fp32_final_loss": round(finals["fp32"], 5),
+            "bf16_mixed_final_loss": round(finals["mixed"], 5),
+            "gap": round(gap, 5), "tolerance": tol,
+            "within_tolerance": bool(gap <= tol)}
+
+    # ---- serving leg: mnist_mlp int8 vs fp32 ---------------------------
+    net = MultiLayerNetwork(mnist_mlp()).init()
+    sy = rng.integers(0, 10, 512)
+    sx = rng.normal(0, 0.3, (512, 784)).astype(np.float32)
+    sx[np.arange(512), sy * 78] += 3.0      # separable synthetic classes
+    for _ in range(10):                      # logits must not be degenerate
+        net.fit_batch(sx, np.eye(10, dtype=np.float32)[sy])
+    qnet = QuantizedNet(net)
+    ladder = BucketLadder((1, 8, 32))
+    probe = rng.normal(0, 0.3, (512, 784)).astype(np.float32)
+    probe[np.arange(512), (np.arange(512) % 10) * 78] += 3.0
+
+    def batched_argmax(model):
+        outs = [model.output_bucketed(probe[i:i + 32], ladder=ladder)
+                for i in range(0, 512, 32)]
+        return np.concatenate(outs).argmax(-1)
+
+    agree = float((batched_argmax(qnet) == batched_argmax(net)).mean())
+    batch32 = probe[:32]
+    jax.block_until_ready(qnet.output(batch32))   # compile both
+    jax.block_until_ready(net.output(batch32))
+    sec_f = _time_steps(lambda: net.output(batch32), 2, steps)
+    sec_q = _time_steps(lambda: qnet.output(batch32), 2, steps)
+    fp32_bytes = int(param_bytes(net))
+    int8_bytes = int(qnet.param_bytes())
+    serving = {
+        "model": "mnist-mlp 784-2048-2048-10",
+        "fp32_param_bytes": fp32_bytes, "int8_param_bytes": int8_bytes,
+        "param_bytes_reduction": round(fp32_bytes / int8_bytes, 2),
+        "top1_agreement": round(agree, 4),
+        "fp32_batch32_ms": round(sec_f * 1e3, 3),
+        "int8_batch32_ms": round(sec_q * 1e3, 3),
+        "int8_vs_fp32_latency": round(sec_f / sec_q, 2)}
+
+    guards = {
+        "train_state_reduction_min": 1.9,
+        "train_state_reduction_pass": bool(mem_reduction >= 1.9),
+        "int8_param_reduction_min": 3.5,
+        "int8_param_reduction_pass": bool(fp32_bytes / int8_bytes >= 3.5),
+        "top1_agreement_min": 0.99,
+        "top1_agreement_pass": bool(agree >= 0.99),
+        "parity_pass": all(p["within_tolerance"] for p in parity.values())}
+    return {"metric": "Precision plane: bf16-mixed train-state reduction",
+            "unit": "x", "value": round(mem_reduction, 3),
+            "train": legs, "parity": parity, "serving": serving,
+            "guards": guards,
+            "meets_acceptance": all(v for k, v in guards.items()
+                                    if k.endswith("_pass"))}
 
 
 def _serving_storm(n_clients: int, requests, handler) -> float:
@@ -896,6 +1072,7 @@ def bench_serving() -> dict:
             "model": "mnist-mlp 784-2048-2048-10",
             "sequential_requests_per_sec": round(total / sec_seq, 1),
             "batched_vs_sequential": round(sec_seq / sec_bat, 2),
+            **_mem_fields(net=net),
             "p50_ms": lat.get("p50_ms"), "p99_ms": lat.get("p99_ms"),
             "compiled_programs": stats.get("compiled_programs"),
             "mean_batch_occupancy": stats.get("mean_batch_occupancy"),
@@ -982,6 +1159,7 @@ def bench_serving_overload() -> dict:
             "deadline_missed": bounded["deadline_missed"],
             "uncontrolled_requests_per_sec": round(
                 open_loop["ok"] / open_loop["sec"], 1),
+            **_mem_fields(net=net),
             "uncontrolled_p99_ms": open_loop["p99_ms"],
             "uncontrolled_shed_rate": open_loop["shed_rate"],
             "model": "mnist-mlp 784-2048-2048-10",
@@ -1048,6 +1226,7 @@ def bench_serving_lm() -> dict:
                       f"({slots} slots)",
             "unit": "tokens/sec", "value": round(n_req * new / sec_bat, 1),
             "requests": n_req, "new_tokens": new, "prompt_len": plen,
+            **_mem_fields(params=params),
             "requests_per_sec": round(n_req / sec_bat, 2),
             "sequential_tokens_per_sec": round(n_req * new / sec_seq, 1),
             "continuous_vs_sequential": round(sec_seq / sec_bat, 2),
@@ -1100,6 +1279,7 @@ BENCHES = {
     "serving": bench_serving,
     "servinglm": bench_serving_lm,
     "servingoverload": bench_serving_overload,
+    "precision": bench_precision,
     "flashab": bench_flash_ab,
     "longctx": bench_longctx,
     "gpt2mem": bench_gpt2_mem,

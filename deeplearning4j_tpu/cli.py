@@ -116,6 +116,14 @@ def cmd_train(args) -> int:
     epochs = int(props.get("train.epochs", args.epochs))
     batch = int(props.get("train.batch.size", args.batch))
 
+    precision = props.get("train.precision", args.precision)
+    if precision and precision != "fp32":
+        # Precision plane: "bf16" = pure bf16 params+compute, "mixed" =
+        # fp32 masters + bf16 compute + dynamic loss scaling (the
+        # production TPU recipe; docs/performance.md precision model).
+        net.set_precision(precision)
+        print(f"precision: {net.precision.describe()}")
+
     divisor = 1
     if args.runtime == "spmd":
         from deeplearning4j_tpu.parallel import DataParallelTrainer
@@ -245,6 +253,10 @@ def cmd_train(args) -> int:
             jax.block_until_ready(last)
     elapsed = time.time() - t0
 
+    scaler = net.scaler_stats()
+    if scaler is not None:
+        print(f"precision: loss-scale {scaler['scale']:g}, "
+              f"{scaler['overflow_count']} overflow step(s) skipped")
     out.mkdir(parents=True, exist_ok=True)
     save_model(net, out / "model")
     save_params(net, out / ("params.bin" if args.savemode == "binary"
@@ -384,12 +396,21 @@ def cmd_serve(args) -> int:
         net = _build_net(args.model)
         ladder = BucketLadder(tuple(
             int(b) for b in args.buckets.split(",")))
+        quantize = args.quantize if args.quantize != "none" else None
         srv.serve_model(net,
                         max_batch=min(args.max_batch, ladder.max_batch),
                         max_wait_ms=args.max_wait_ms, ladder=ladder,
                         max_queue_depth=max_queue,
                         default_deadline_s=deadline_s,
-                        breaker_threshold=breaker_n)
+                        breaker_threshold=breaker_n,
+                        quantize=quantize)
+        if quantize:
+            rep = srv.state.engine._model().quantization_report()
+            ratio = rep["float_param_bytes"] / max(rep["param_bytes"], 1)
+            print(f"serve: {quantize} weights — "
+                  f"{rep['quantized_layers']}/{rep['total_layers']} layers "
+                  f"quantized, {rep['param_bytes']:,} param bytes "
+                  f"({ratio:.1f}x smaller than fp32)")
         from deeplearning4j_tpu.nn.conf import DenseLayerConf
 
         first = net.conf.layers[0]
@@ -725,6 +746,13 @@ def build_parser() -> argparse.ArgumentParser:
     p_train.add_argument("-accum", "--accum", type=int, default=1,
                          help="gradient-accumulation microbatches per "
                               "update (local runtime)")
+    p_train.add_argument("-precision", "--precision",
+                         choices=["fp32", "bf16", "mixed"], default="fp32",
+                         help="precision policy: fp32; bf16 (pure bf16 "
+                              "params+compute, half the train-state "
+                              "bytes); mixed (fp32 master weights + "
+                              "bf16 compute + dynamic loss scaling — "
+                              "the production TPU recipe)")
     p_train.add_argument("-chunk", "--chunk", type=int, default=1,
                          help="fused multi-step driver: optimizer steps "
                               "per XLA dispatch (one host sync per "
@@ -858,6 +886,13 @@ def build_parser() -> argparse.ArgumentParser:
     p_serve.add_argument("-warmup", "--warmup", action="store_true",
                          help="pre-compile every bucket shape before "
                               "accepting traffic")
+    p_serve.add_argument("-quantize", "--quantize",
+                         choices=["none", "int8"], default="none",
+                         help="serve int8 per-channel weight-quantized "
+                              "dense/conv layers (~4x smaller resident "
+                              "params, dequantize-in-kernel matmuls; "
+                              "top-1 parity pinned by the bench "
+                              "precision row)")
     p_serve.add_argument("-max-queue", "--max-queue", dest="max_queue",
                          type=int, default=256,
                          help="bounded admission: queued requests past "

@@ -15,6 +15,7 @@ import numpy as np
 from deeplearning4j_tpu.datasets.fetchers import one_hot
 from deeplearning4j_tpu.models import MultiLayerNetwork
 from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+from deeplearning4j_tpu.precision import default_dtype
 
 
 class _BaseEstimator:
@@ -39,7 +40,7 @@ class StandardScaler(_BaseEstimator):
         self.std_: Optional[np.ndarray] = None
 
     def fit(self, x, y=None) -> "StandardScaler":
-        x = np.asarray(x, np.float32)
+        x = np.asarray(x, default_dtype())
         self.mean_ = x.mean(axis=0)
         self.std_ = x.std(axis=0)
         self.std_[self.std_ == 0] = 1.0
@@ -48,7 +49,7 @@ class StandardScaler(_BaseEstimator):
     def transform(self, x) -> np.ndarray:
         if self.mean_ is None:
             raise ValueError("fit() first")
-        return (np.asarray(x, np.float32) - self.mean_) / self.std_
+        return (np.asarray(x, default_dtype()) - self.mean_) / self.std_
 
     def fit_transform(self, x, y=None) -> np.ndarray:
         return self.fit(x, y).transform(x)
@@ -77,7 +78,9 @@ class NetworkClassifier(_BaseEstimator):
         return self._net
 
     def fit(self, x, y) -> "NetworkClassifier":
-        x = np.asarray(x, np.float32)
+        # precision plane: feed the net's DECLARED input dtype instead of
+        # silently upcasting every batch to 4-byte floats
+        x = np.asarray(x, default_dtype(self.conf))
         y = np.asarray(y)
         if y.ndim == 1:
             n_out = self.conf.layers[-1].n_out
@@ -101,7 +104,7 @@ class NetworkClassifier(_BaseEstimator):
 
     def predict_proba(self, x) -> np.ndarray:
         return np.asarray(self.network.label_probabilities(
-            np.asarray(x, np.float32)))
+            np.asarray(x, default_dtype(self.network))))
 
     def predict(self, x) -> np.ndarray:
         return self.predict_proba(x).argmax(axis=1)
@@ -129,9 +132,9 @@ class NetworkReconstruction(_BaseEstimator):
     def fit(self, x, y=None) -> "NetworkReconstruction":
         from deeplearning4j_tpu.datasets import ArrayDataSetIterator
 
-        x = np.asarray(x, np.float32)
+        x = np.asarray(x, default_dtype(self.conf))
         self._net = MultiLayerNetwork(self.conf).init()
-        dummy = np.zeros((len(x), 1), np.float32)
+        dummy = np.zeros((len(x), 1), default_dtype(self.conf))
         it = ArrayDataSetIterator(x, dummy, batch=self.batch_size)
         self._net.pretrain(it, epochs=self.epochs)
         return self
@@ -139,7 +142,8 @@ class NetworkReconstruction(_BaseEstimator):
     def transform(self, x) -> np.ndarray:
         if self._net is None:
             raise ValueError("fit() first")
-        acts = self._net.feed_forward(np.asarray(x, np.float32))
+        acts = self._net.feed_forward(
+            np.asarray(x, default_dtype(self._net)))
         return np.asarray(acts[self.layer])
 
     def fit_transform(self, x, y=None) -> np.ndarray:

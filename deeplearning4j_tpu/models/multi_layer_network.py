@@ -47,6 +47,14 @@ from deeplearning4j_tpu.ops.updaters import (
     global_grad_norm,
     make_updater,
 )
+from deeplearning4j_tpu.precision import (
+    grads_finite,
+    init_scaler_state,
+    resolve_policy,
+    unscale_grads,
+    update_scaler_state,
+    where_tree,
+)
 
 PyTree = Any
 
@@ -128,7 +136,15 @@ class MultiLayerNetwork:
                 "has no learning-rate term, so scaling the applied step "
                 "desynchronizes the accumulated-update state")
         self._updater = make_updater(conf.conf.updater_config())
-        self._dtype = jnp.dtype(conf.conf.dtype)
+        # Precision plane (precision/): the policy object owns every
+        # dtype decision — param (master) dtype, compute dtype, output
+        # dtype, and whether the train step runs the dynamic loss
+        # scaler.  Derived from the conf by default (back-compat with
+        # the dtype/compute_dtype fields); `set_precision`/
+        # `fit(precision=...)` override it.
+        self._precision = resolve_policy(None, conf.conf)
+        self._dtype = jnp.dtype(self._precision.param_dtype)
+        self._scaler_state = None  # device automaton state when scaling
         # Supervisor hook points (resilience/): a traced update scale the
         # TrainingSupervisor backs off on rollback without recompiling,
         # and the last step's global gradient norm (device array, synced
@@ -141,6 +157,57 @@ class MultiLayerNetwork:
         self._jit_forward = None
         self._jit_score = None
         self._iteration = 0
+
+    # ---- precision policy --------------------------------------------------
+
+    @property
+    def precision(self):
+        """The live :class:`~deeplearning4j_tpu.precision.PrecisionPolicy`."""
+        return self._precision
+
+    def set_precision(self, policy) -> "MultiLayerNetwork":
+        """Adopt a precision policy (a PrecisionPolicy, a named policy —
+        "fp32" / "bf16" / "mixed" — or None to re-derive from the conf).
+
+        Changing the COMPUTE dtype or the loss-scaling mode only clears
+        the jit caches (the next step compiles once under the new
+        policy).  Changing the PARAM dtype additionally casts the live
+        master weights and re-initializes the optimizer state — moments
+        accumulated in one dtype are not meaningful in another."""
+        policy = resolve_policy(policy, self.conf.conf)
+        if policy == self._precision:
+            return self
+        old_param_dtype = jnp.dtype(self._precision.param_dtype)
+        self._precision = policy
+        self._dtype = jnp.dtype(policy.param_dtype)
+        # compiled programs bake the old dtypes in — drop them all
+        self._jit_train_step = None
+        self._jit_train_chunk = None
+        self._jit_forward = None
+        self._jit_score = None
+        self._scaler_state = None
+        if self.params is not None and self._dtype != old_param_dtype:
+            cast = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda a: a.astype(self._dtype)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+                t)
+            self.params = cast(self.params)
+            self.updater_state = (self._updater.init(self.params)
+                                  if self.updater_state is not None else None)
+        return self
+
+    def scaler_stats(self) -> Optional[dict]:
+        """Loss-scaler automaton snapshot ({scale, good_steps,
+        overflow_count}) — the precision plane's health-path observable:
+        a growing overflow_count means steps are being skipped (masters
+        stay clean) and the scale is backing off.  None when the policy
+        does not scale (or no scaled step ran yet)."""
+        if self._scaler_state is None:
+            return None
+        return {"scale": float(self._scaler_state["scale"]),
+                "good_steps": int(self._scaler_state["good_steps"]),
+                "overflow_count":
+                    int(self._scaler_state["overflow_count"])}
 
     # ---- construction -----------------------------------------------------
 
@@ -216,7 +283,7 @@ class MultiLayerNetwork:
     def _forward(self, params, state, x, *, train: bool, rng=None, mask=None,
                  upto: Optional[int] = None, collect: bool = False):
         """Pure forward fold. Returns (activations_or_final, new_state)."""
-        compute_dtype = jnp.dtype(self.conf.conf.compute_dtype)
+        compute_dtype = jnp.dtype(self._precision.compute_dtype)
         params = self._cast_floating(params, compute_dtype)
         if jnp.issubdtype(x.dtype, jnp.floating):
             x = x.astype(compute_dtype)
@@ -257,7 +324,7 @@ class MultiLayerNetwork:
                      else None)
         x = input_dropout(lc, x, train, layer_rng)
         p = self._cast_floating(params[-1],
-                                jnp.dtype(self.conf.conf.compute_dtype))
+                                jnp.dtype(self._precision.compute_dtype))
         W = effective_weights(lc, p, train, layer_rng)
         if x.ndim == 3:
             z = jnp.einsum("bti,io->bto", x, W) + p["b"]
@@ -374,6 +441,46 @@ class MultiLayerNetwork:
                                        up)
                 for lc, up in zip(self.conf.layers, updates)]
 
+    def _make_scaled_train_step(self):
+        """The mixed-precision train step: loss scaled by the dynamic
+        automaton before differentiation, gradients unscaled, and — on
+        any non-finite gradient — the WHOLE update skipped via
+        `jnp.where` selects (params, optimizer state and layer state all
+        keep their pre-step values) while the scale backs off.  The
+        automaton state rides the step as a donated pytree of device
+        scalars, so scale changes never recompile and never sync."""
+        updater = self._updater
+        scfg = self._precision.loss_scale
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def train_step(params, state, upd_state, sc_state, x, y, rng, mask,
+                       lr_scale):
+            scale = sc_state["scale"]
+
+            def lossfn(p):
+                loss, new_state = self._objective(p, state, x, y, rng, mask)
+                return loss * scale.astype(loss.dtype), (loss, new_state)
+
+            (_, (loss, new_state)), grads = jax.value_and_grad(
+                lossfn, has_aux=True)(params)
+            grads = unscale_grads(grads, scale)
+            finite = jnp.logical_and(grads_finite(grads), jnp.isfinite(loss))
+            # The health observable is the UNSCALED norm: non-finite on
+            # overflow, so the supervisor sees the event (and its
+            # recovery is trivial — the masters were never touched).
+            gnorm = global_grad_norm(grads)
+            updates, new_upd = updater.update(grads, upd_state, params)
+            updates = self._apply_lr_multipliers(updates)
+            updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
+            new_params = apply_updates(params, updates)
+            params = where_tree(finite, new_params, params)
+            upd_state = where_tree(finite, new_upd, upd_state)
+            new_state = where_tree(finite, new_state, state)
+            sc_state = update_scaler_state(scfg, sc_state, finite)
+            return params, new_state, upd_state, sc_state, loss, gnorm
+
+        return train_step
+
     def _make_train_step(self, accum: int = 1):
         updater = self._updater
 
@@ -459,45 +566,91 @@ class MultiLayerNetwork:
 
         `unroll=1` (default) keeps the scan rolled: one compiled body for
         any trip count, so chunked == unchunked bit-for-bit.  `unroll>1`
-        trades that for cross-step XLA fusion (see _CHUNK_UNROLL_CAP)."""
+        trades that for cross-step XLA fusion (see _CHUNK_UNROLL_CAP).
+
+        Under a loss-scaled precision policy the scaler automaton rides
+        the scan carry: each step scales the loss, unscales the
+        gradients, where-skips the update on overflow and transitions
+        the scale — so a poison batch mid-chunk skips ITS step only and
+        the rest of the chunk trains on clean masters."""
         updater = self._updater
+        scfg = self._precision.loss_scale
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def train_chunk(params, state, upd_state, xs, ys, ws, masks, it0,
-                        lr_scale):
-            base = jax.random.PRNGKey(self.conf.conf.seed)
-
-            def body(carry, inp):
+        def chunk_body(carry, inp, lr_scale):
+            if scfg is None:
                 params, state, upd = carry
-                if has_mask:
-                    xi, yi, wi, mi, it = inp
-                else:
-                    (xi, yi, wi, it), mi = inp, None
-                rng = jax.random.fold_in(base, it)
+            else:
+                params, state, upd, sc_state = carry
+            if has_mask:
+                xi, yi, wi, mi, it = inp
+            else:
+                (xi, yi, wi, it), mi = inp, None
+            base = jax.random.PRNGKey(self.conf.conf.seed)
+            rng = jax.random.fold_in(base, it)
 
+            if scfg is None:
                 def lossfn(p):
                     return self._weighted_objective(p, state, xi, yi, rng,
                                                     mi, wi)
 
                 (loss, new_state), grads = jax.value_and_grad(
                     lossfn, has_aux=True)(params)
-                gnorm = global_grad_norm(grads)
-                updates, upd = updater.update(grads, upd, params)
-                updates = self._apply_lr_multipliers(updates)
-                updates = jax.tree_util.tree_map(lambda u: u * lr_scale,
-                                                 updates)
-                params = apply_updates(params, updates)
-                return (params, new_state, upd), (loss, gnorm)
+            else:
+                scale = sc_state["scale"]
 
+                def lossfn(p):
+                    loss, new_state = self._weighted_objective(
+                        p, state, xi, yi, rng, mi, wi)
+                    return loss * scale.astype(loss.dtype), (loss, new_state)
+
+                (_, (loss, new_state)), grads = jax.value_and_grad(
+                    lossfn, has_aux=True)(params)
+                grads = unscale_grads(grads, scale)
+            gnorm = global_grad_norm(grads)
+            updates, new_upd = updater.update(grads, upd, params)
+            updates = self._apply_lr_multipliers(updates)
+            updates = jax.tree_util.tree_map(lambda u: u * lr_scale,
+                                             updates)
+            new_params = apply_updates(params, updates)
+            if scfg is None:
+                return (new_params, new_state, new_upd), (loss, gnorm)
+            finite = jnp.logical_and(grads_finite(grads),
+                                     jnp.isfinite(loss))
+            params = where_tree(finite, new_params, params)
+            upd = where_tree(finite, new_upd, upd)
+            state = where_tree(finite, new_state, state)
+            sc_state = update_scaler_state(scfg, sc_state, finite)
+            return (params, state, upd, sc_state), (loss, gnorm)
+
+        if scfg is None:
+            @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+            def train_chunk(params, state, upd_state, xs, ys, ws, masks,
+                            it0, lr_scale):
+                its = it0 + jnp.arange(xs.shape[0])
+                inputs = ((xs, ys, ws, masks, its) if has_mask
+                          else (xs, ys, ws, its))
+                (params, state, upd_state), (losses, gnorms) = lax.scan(
+                    lambda c, i: chunk_body(c, i, lr_scale),
+                    (params, state, upd_state), inputs,
+                    unroll=min(int(xs.shape[0]), unroll, _CHUNK_UNROLL_CAP))
+                return params, state, upd_state, losses, gnorms
+
+            return train_chunk
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def train_chunk_scaled(params, state, upd_state, sc_state, xs, ys,
+                               ws, masks, it0, lr_scale):
             its = it0 + jnp.arange(xs.shape[0])
             inputs = ((xs, ys, ws, masks, its) if has_mask
                       else (xs, ys, ws, its))
-            (params, state, upd_state), (losses, gnorms) = lax.scan(
-                body, (params, state, upd_state), inputs,
-                unroll=min(int(xs.shape[0]), unroll, _CHUNK_UNROLL_CAP))
-            return params, state, upd_state, losses, gnorms
+            (params, state, upd_state, sc_state), (losses, gnorms) = \
+                lax.scan(
+                    lambda c, i: chunk_body(c, i, lr_scale),
+                    (params, state, upd_state, sc_state), inputs,
+                    unroll=min(int(xs.shape[0]), unroll, _CHUNK_UNROLL_CAP))
+            return params, state, upd_state, sc_state, losses, gnorms
 
-        return train_chunk
+        return train_chunk_scaled
 
     def fit_chunk_async(self, xs, ys, masks=None, weights=None,
                         unroll: int = 1) -> Tuple[jax.Array, jax.Array]:
@@ -521,16 +674,29 @@ class MultiLayerNetwork:
             weights = jnp.asarray(weights, jnp.float32)
         if self._jit_train_chunk is None:
             self._jit_train_chunk = {}
-        key = (masks is not None, max(1, int(unroll)))
+        scaled = self._precision.loss_scale is not None
+        key = (masks is not None, max(1, int(unroll)), scaled)
         step = self._jit_train_chunk.get(key)
         if step is None:
             step = self._jit_train_chunk[key] = \
                 self._make_train_chunk(key[0], key[1])
         it0 = self._iteration
-        (self.params, self.state, self.updater_state, losses, gnorms) = step(
-            self.params, self.state, self.updater_state, xs, ys, weights,
-            masks, jnp.asarray(it0, jnp.int32),
-            jnp.asarray(self._lr_scale, jnp.float32))
+        if scaled:
+            if self._scaler_state is None:
+                self._scaler_state = init_scaler_state(
+                    self._precision.loss_scale)
+            (self.params, self.state, self.updater_state,
+             self._scaler_state, losses, gnorms) = step(
+                self.params, self.state, self.updater_state,
+                self._scaler_state, xs, ys, weights, masks,
+                jnp.asarray(it0, jnp.int32),
+                jnp.asarray(self._lr_scale, jnp.float32))
+        else:
+            (self.params, self.state, self.updater_state, losses,
+             gnorms) = step(
+                self.params, self.state, self.updater_state, xs, ys,
+                weights, masks, jnp.asarray(it0, jnp.int32),
+                jnp.asarray(self._lr_scale, jnp.float32))
         self._iteration += k
         self.last_grad_norm = gnorms[-1]
         self._fire_chunk_listeners(it0, k, losses)
@@ -588,22 +754,40 @@ class MultiLayerNetwork:
         if accum_steps > 1 and jnp.shape(x)[0] % accum_steps:
             raise ValueError(f"batch {jnp.shape(x)[0]} not divisible by "
                              f"accum_steps {accum_steps}")
+        scaled = self._precision.loss_scale is not None
+        if scaled and accum_steps > 1:
+            raise ValueError(
+                "accum_steps > 1 is not supported with a loss-scaled "
+                "precision policy (a microbatch scan cannot skip one "
+                "overflowed microbatch); use plain batches or a policy "
+                "without loss scaling")
         if self._jit_train_step is None:
             self._jit_train_step = {}
-        step = self._jit_train_step.get(accum_steps)
+        key = (accum_steps, scaled)
+        step = self._jit_train_step.get(key)
         if step is None:
-            step = self._jit_train_step[accum_steps] = \
-                self._make_train_step(accum_steps)
+            step = self._jit_train_step[key] = (
+                self._make_scaled_train_step() if scaled
+                else self._make_train_step(accum_steps))
         rng = jax.random.fold_in(
             jax.random.PRNGKey(self.conf.conf.seed), self._iteration)
         x = jnp.asarray(x)
         y = jnp.asarray(y)
         mask = None if mask is None else jnp.asarray(mask)
         lr_scale = jnp.asarray(self._lr_scale, jnp.float32)
-        (self.params, self.state, self.updater_state, loss,
-         self.last_grad_norm) = step(
-            self.params, self.state, self.updater_state, x, y, rng, mask,
-            lr_scale)
+        if scaled:
+            if self._scaler_state is None:
+                self._scaler_state = init_scaler_state(
+                    self._precision.loss_scale)
+            (self.params, self.state, self.updater_state,
+             self._scaler_state, loss, self.last_grad_norm) = step(
+                self.params, self.state, self.updater_state,
+                self._scaler_state, x, y, rng, mask, lr_scale)
+        else:
+            (self.params, self.state, self.updater_state, loss,
+             self.last_grad_norm) = step(
+                self.params, self.state, self.updater_state, x, y, rng, mask,
+                lr_scale)
         self._iteration += 1
         due = self._due_listeners(self._iteration)
         if due:
@@ -662,7 +846,8 @@ class MultiLayerNetwork:
 
     def fit(self, data, epochs: int = 1, accum_steps: int = 1,
             chunk_size: Optional[int] = None, prefetch: int = 2,
-            chunk_unroll: int = 1) -> "MultiLayerNetwork":
+            chunk_unroll: int = 1,
+            precision=None) -> "MultiLayerNetwork":
         """Train from a DataSetIterator-like iterable (yielding objects with
         .features/.labels/.mask or (x, y) tuples) or a single (x, y) pair.
         Runs `conf.pretrain` greedy pretraining first if configured
@@ -678,8 +863,15 @@ class MultiLayerNetwork:
         executes the identical compiled step body, so results are
         BITWISE chunk-size invariant; `chunk_unroll>1` unrolls the scan
         for cross-step XLA fusion (faster on CPU, low-order bits then
-        depend on the chunking)."""
+        depend on the chunking).
+
+        `precision` adopts a precision policy for this (and subsequent)
+        training — a PrecisionPolicy or a named one ("fp32", "bf16",
+        "mixed"); see `set_precision` / docs/performance.md."""
         import types
+
+        if precision is not None:
+            self.set_precision(precision)
 
         if isinstance(data, types.GeneratorType):
             # One-shot generators can't replay across epochs/pretrain passes.
@@ -832,9 +1024,10 @@ class MultiLayerNetwork:
         if self.params is None:
             self.init()
         if self._jit_forward is None:
+            out_dtype = jnp.dtype(self._precision.output_dtype)
             self._jit_forward = jax.jit(
                 lambda p, s, x, mask: self._forward(
-                    p, s, x, train=False, mask=mask)[0])
+                    p, s, x, train=False, mask=mask)[0].astype(out_dtype))
         return self._jit_forward(self.params, self.state, jnp.asarray(x), mask)
 
     def output_bucketed(self, x, mask=None, ladder=None) -> np.ndarray:
@@ -950,17 +1143,28 @@ class MultiLayerNetwork:
     def num_params(self) -> int:
         return int(sum(np.prod(a.shape) for _, a in self._param_leaves()))
 
-    def params_flat(self) -> np.ndarray:
+    def params_flat(self, dtype=np.float32) -> np.ndarray:
         """Single flat float vector, deterministic order (reference params()
-        :836 / pack() :883)."""
+        :836 / pack() :883).  `dtype=None` keeps the net's native param
+        dtype (the checkpoint/serving format for narrow-dtype nets: a
+        bf16 net ships 2 bytes/param instead of silently upcasting);
+        the float32 default preserves the historical shipping format."""
+        if not self.params:
+            return np.zeros((0,), dtype if dtype is not None else np.float32)
+        if dtype is None:
+            dtype = np.asarray(self._param_leaves()[0][1]).dtype
         return np.concatenate(
-            [np.asarray(a, dtype=np.float32).reshape(-1)
-             for _, a in self._param_leaves()]
-        ) if self.params else np.zeros((0,), np.float32)
+            [np.asarray(a).astype(dtype, copy=False).reshape(-1)
+             for _, a in self._param_leaves()])
 
     def set_params_flat(self, vec: np.ndarray) -> None:
-        """Inverse of params_flat (reference setParameters()/unPack() :1555/:927)."""
-        vec = np.asarray(vec, np.float32)
+        """Inverse of params_flat (reference setParameters()/unPack()
+        :1555/:927).  Accepts any floating dtype; each chunk is cast to
+        its leaf's dtype (so a float32 vector restores a bf16 net and
+        vice versa)."""
+        vec = np.asarray(vec)
+        if vec.dtype.kind not in "f" and str(vec.dtype) != "bfloat16":
+            vec = vec.astype(np.float32)
         expected = self.num_params()
         if vec.size != expected:
             raise ValueError(

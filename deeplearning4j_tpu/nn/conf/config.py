@@ -46,9 +46,13 @@ class NeuralNetConfiguration:
     minimize: bool = True
     step_function: str = "default"
     use_dropconnect: bool = False
-    # TPU-specific policy knobs (no reference analog):
-    dtype: str = "float32"            # parameter dtype
+    # TPU-specific precision-policy knobs (no reference analog; see
+    # deeplearning4j_tpu/precision/ — these three fields ARE the
+    # persisted form of the net's PrecisionPolicy, so the policy
+    # round-trips through the conf-JSON shipping format):
+    dtype: str = "float32"            # parameter (master-weight) dtype
     compute_dtype: str = "float32"    # activation/matmul dtype (e.g. bfloat16)
+    output_dtype: str = "float32"     # what output()/serving hand back
 
     def __post_init__(self):
         # No config knob may be a silent no-op. step_function variants

@@ -71,13 +71,22 @@ def batchnorm_init(conf: L.BatchNormConf, key: jax.Array, dtype=jnp.float32):
 def batchnorm_apply(conf, params, state, x, *, train=False, rng=None, mask=None):
     axes = tuple(range(x.ndim - 1))  # normalise over all but the channel axis
     if train:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
+        # Moments always accumulate in f32 (precision plane): a bf16
+        # sum-of-squares over a real batch loses most of its mantissa,
+        # and the running stats feed EVERY later inference.  Identity
+        # for f32 inputs, so the default policy's numerics are untouched.
+        xf = x.astype(jnp.float32)
+        mean_f32 = jnp.mean(xf, axis=axes)
+        var_f32 = jnp.var(xf, axis=axes)
         m = conf.momentum
+        # running stats update from the FULL-resolution f32 moments;
+        # only the copies used to normalize this batch drop to x.dtype
         new_state = {
-            "mean": m * state["mean"] + (1 - m) * mean.astype(jnp.float32),
-            "var": m * state["var"] + (1 - m) * var.astype(jnp.float32),
+            "mean": m * state["mean"] + (1 - m) * mean_f32,
+            "var": m * state["var"] + (1 - m) * var_f32,
         }
+        mean = mean_f32.astype(x.dtype)
+        var = var_f32.astype(x.dtype)
     else:
         mean, var = state["mean"].astype(x.dtype), state["var"].astype(x.dtype)
         new_state = state

@@ -274,10 +274,23 @@ def make_updater(cfg: UpdaterConfig) -> UpdaterTransform:
         else:
             raise ValueError(f"Unhandled updater: {kind}")
 
+        # Accumulators keep their INITIAL dtype (f32-scalar hyperparams
+        # promote bf16 moments to f32 otherwise — the optimizer state of
+        # a pure-bf16 policy would silently double after one step).
+        # Identity for f32 states.
+        new_state = jax.tree_util.tree_map(
+            lambda o, n: jnp.asarray(n).astype(jnp.asarray(o).dtype),
+            state, new_state)
         return updates, new_state
 
     return UpdaterTransform(init=init, update=update)
 
 
 def apply_updates(params: PyTree, updates: PyTree) -> PyTree:
-    return jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+    """p + u, PRESERVING each param's dtype.  The lr scalar is float32,
+    so a bf16 param's update promotes to f32 — without the cast-back a
+    pure-bf16 net silently becomes f32 after one step.  The sum itself
+    happens in the promoted dtype (more mantissa for the accumulate),
+    then stores back narrow; identity for f32 nets."""
+    return jax.tree_util.tree_map(
+        lambda p, u: (p + u).astype(jnp.asarray(p).dtype), params, updates)

@@ -54,6 +54,13 @@ from deeplearning4j_tpu.ops.updaters import (
     make_updater,
 )
 from deeplearning4j_tpu.parallel import mesh as mesh_lib
+from deeplearning4j_tpu.precision import (
+    grads_finite,
+    init_scaler_state,
+    unscale_grads,
+    update_scaler_state,
+    where_tree,
+)
 
 
 class DataParallelTrainer:
@@ -89,6 +96,18 @@ class DataParallelTrainer:
                 "(non-elementwise gradient transforms); use the "
                 "replicated DP path for those configs")
         self._updater = make_updater(ucfg)
+        # Precision plane: the net's policy rides into the SPMD step.
+        # The dynamic loss scaler only composes with the plain sync path
+        # — local-SGD replicas would need per-replica automatons and the
+        # flat ZeRO-1 shard has no gradient tree to finiteness-check
+        # before the scatter.
+        if net.precision.loss_scale is not None and (
+                shard_update or sync_every != 1):
+            raise ValueError(
+                "a loss-scaled precision policy (e.g. 'mixed') requires "
+                "the plain synchronous DP path; drop shard_update/"
+                "sync_every or use a policy without loss scaling")
+        self._built_policy = net.precision
         if shard_update:
             self._step_fn = self._build_sharded_update_step()
         else:
@@ -101,36 +120,107 @@ class DataParallelTrainer:
 
     # ---- the SPMD step ----------------------------------------------------
 
+    def _check_policy(self) -> None:
+        """Rebuild the compiled SPMD steps when the net's precision
+        policy changed since construction (`net.set_precision` /
+        `fit(precision=...)`): the steps bake the compute dtype and the
+        scaler mode in.  Same restrictions as the constructor."""
+        if self.net.precision == self._built_policy:
+            return
+        if self.net.precision.loss_scale is not None and (
+                self.shard_update or self.sync_every != 1):
+            raise ValueError(
+                "a loss-scaled precision policy (e.g. 'mixed') requires "
+                "the plain synchronous DP path; drop shard_update/"
+                "sync_every or use a policy without loss scaling")
+        self._built_policy = self.net.precision
+        self._chunk_step_fn = {}
+        # Trainer-held training state was built under the OLD policy and
+        # must not leak through the change:
+        if self._rep is not None:
+            # local-SGD: fold outstanding per-replica drift into the net
+            # (in the old dtype — the publish overwrites the cast
+            # `set_precision` already applied), then re-apply the new
+            # param dtype so the next step restacks cast masters.
+            self._average_params()
+            self._rep = None
+            dtype = jnp.dtype(self.net.precision.param_dtype)
+            self.net.params = jax.tree_util.tree_map(
+                lambda a: a.astype(dtype)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                else a, self.net.params)
+            if self.net.updater_state is not None:
+                self.net.updater_state = self._updater.init(self.net.params)
+        self._avg_fn = None  # compiled for the old dtype
+        if self.shard_update:
+            # the flat ravel/unravel template bakes the param dtype in;
+            # _build_sharded_update_step re-inits the opt-state shards
+            if hasattr(self, "_flat_cache"):
+                del self._flat_cache
+            self._step_fn = self._build_sharded_update_step()
+        else:
+            self._step_fn = (self._build_step() if self.sync_every == 1
+                             else self._build_local_step())
+
     def _build_step(self):
         net = self.net
         updater = self._updater
         axis = self.axis
+        scfg = net.precision.loss_scale
 
-        def shard_step(params, state, upd_state, x, y, rng, mask, lr_scale):
+        def shard_step(params, state, upd_state, sc_state, x, y, rng, mask,
+                       lr_scale):
             # Different dropout/sampling per shard, same init everywhere.
             rng = jax.random.fold_in(rng, lax.axis_index(axis))
 
-            def lossfn(p):
-                return net._objective(p, state, x, y, rng, mask)
+            if scfg is None:
+                def lossfn(p):
+                    return net._objective(p, state, x, y, rng, mask)
 
-            (loss, new_state), grads = jax.value_and_grad(
-                lossfn, has_aux=True)(params)
+                (loss, new_state), grads = jax.value_and_grad(
+                    lossfn, has_aux=True)(params)
+            else:
+                # Mixed precision: the per-shard loss is scaled BEFORE
+                # differentiation; the pmean'd gradient is unscaled
+                # after the collective, so an overflow on ANY shard is
+                # visible to ALL replicas (pmean of inf is inf
+                # everywhere) and they skip the update in lockstep —
+                # no divergence, no extra collective.
+                scale = sc_state["scale"]
+
+                def lossfn(p):
+                    loss, new_state = net._objective(p, state, x, y, rng,
+                                                     mask)
+                    return loss * scale.astype(loss.dtype), (loss, new_state)
+
+                (_, (loss, new_state)), grads = jax.value_and_grad(
+                    lossfn, has_aux=True)(params)
             # The collective: gradient allreduce over ICI. This single
             # line replaces Spark broadcast+accumulate, Akka
             # IterativeReduce, and the YARN master (SURVEY §3.2).
             grads = lax.pmean(grads, axis)
             loss = lax.pmean(loss, axis)
+            if scfg is not None:
+                grads = unscale_grads(grads, sc_state["scale"])
             gnorm = global_grad_norm(grads)
             new_state = jax.tree_util.tree_map(
                 lambda s: lax.pmean(s, axis) if jnp.issubdtype(
                     jnp.asarray(s).dtype, jnp.floating) else s,
                 new_state)
-            updates, upd_state = updater.update(grads, upd_state, params)
+            updates, new_upd = updater.update(grads, upd_state, params)
             updates = net._apply_lr_multipliers(updates)
             updates = jax.tree_util.tree_map(lambda u: u * lr_scale,
                                              updates)
-            params = apply_updates(params, updates)
-            return params, new_state, upd_state, loss, gnorm
+            new_params = apply_updates(params, updates)
+            if scfg is None:
+                return new_params, new_state, new_upd, sc_state, loss, gnorm
+            finite = jnp.logical_and(grads_finite(grads),
+                                     jnp.isfinite(loss))
+            params = where_tree(finite, new_params, params)
+            upd_state = where_tree(finite, new_upd, upd_state)
+            new_state = where_tree(finite, new_state, state)
+            sc_state = update_scaler_state(scfg, sc_state, finite)
+            return params, new_state, upd_state, sc_state, loss, gnorm
 
         pspec = P()          # replicated params/state
         dspec = P(self.axis)  # batch-sharded data
@@ -138,9 +228,9 @@ class DataParallelTrainer:
         fn = shard_map(
             shard_step,
             mesh=self.mesh,
-            in_specs=(pspec, pspec, pspec, dspec, dspec, pspec, dspec,
-                      pspec),
-            out_specs=(pspec, pspec, pspec, pspec, pspec),
+            in_specs=(pspec, pspec, pspec, pspec, dspec, dspec, pspec,
+                      dspec, pspec),
+            out_specs=(pspec, pspec, pspec, pspec, pspec, pspec),
             check_rep=False,
         )
         return jax.jit(fn)
@@ -163,14 +253,18 @@ class DataParallelTrainer:
         net = self.net
         updater = self._updater
         axis = self.axis
+        scfg = net.precision.loss_scale
 
-        def shard_chunk(params, state, upd_state, xs, ys, ws, masks, it0,
-                        lr_scale):
+        def shard_chunk(params, state, upd_state, sc_state, xs, ys, ws,
+                        masks, it0, lr_scale):
             base = jax.random.PRNGKey(net.conf.conf.seed)
             idx = lax.axis_index(axis)
 
             def body(carry, inp):
-                params, state, upd = carry
+                if scfg is None:
+                    params, state, upd = carry
+                else:
+                    params, state, upd, sc = carry
                 if has_mask:
                     xi, yi, wi, mi, it = inp
                 else:
@@ -183,17 +277,25 @@ class DataParallelTrainer:
                 # land unevenly across shards (a whole shard can be pure
                 # padding), and a pmean of per-shard weighted means would
                 # weight such shards wrongly.  This form equals the
-                # single-device weighted objective exactly.
+                # single-device weighted objective exactly.  Under a
+                # loss-scaled policy the numerator is scaled before
+                # differentiation and the psum'd gradient unscaled after
+                # — overflow anywhere is inf everywhere post-psum, so
+                # every replica skips the step in lockstep.
                 def lossfn(p):
                     num, den, new_state = net._weighted_loss_sums(
                         p, state, xi, yi, rng, mi, wi)
-                    return num, (den, new_state)
+                    num_d = (num if scfg is None
+                             else num * sc["scale"].astype(num.dtype))
+                    return num_d, (num, den, new_state)
 
-                (num, (den, new_state)), grads = jax.value_and_grad(
+                (_, (num, den, new_state)), grads = jax.value_and_grad(
                     lossfn, has_aux=True)(params)
                 denom = jnp.maximum(lax.psum(den, axis), 1.0)
                 grads = jax.tree_util.tree_map(
                     lambda g: lax.psum(g, axis) / denom, grads)
+                if scfg is not None:
+                    grads = unscale_grads(grads, sc["scale"])
                 loss = lax.psum(num, axis) / denom
                 if net._has_reg():
                     # replicated term: add its gradient once, post-psum
@@ -207,43 +309,57 @@ class DataParallelTrainer:
                     lambda s: lax.pmean(s, axis) if jnp.issubdtype(
                         jnp.asarray(s).dtype, jnp.floating) else s,
                     new_state)
-                updates, upd = updater.update(grads, upd, params)
+                updates, new_upd = updater.update(grads, upd, params)
                 updates = net._apply_lr_multipliers(updates)
                 updates = jax.tree_util.tree_map(lambda u: u * lr_scale,
                                                  updates)
-                params = apply_updates(params, updates)
-                return (params, new_state, upd), (loss, gnorm)
+                new_params = apply_updates(params, updates)
+                if scfg is None:
+                    return (new_params, new_state, new_upd), (loss, gnorm)
+                finite = jnp.logical_and(grads_finite(grads),
+                                         jnp.isfinite(loss))
+                params = where_tree(finite, new_params, params)
+                upd = where_tree(finite, new_upd, upd)
+                state = where_tree(finite, new_state, state)
+                sc = update_scaler_state(scfg, sc, finite)
+                return (params, state, upd, sc), (loss, gnorm)
 
             its = it0 + jnp.arange(xs.shape[0])
             inputs = ((xs, ys, ws, masks, its) if has_mask
                       else (xs, ys, ws, its))
-            (params, state, upd_state), (losses, gnorms) = lax.scan(
-                body, (params, state, upd_state), inputs,
+            carry = ((params, state, upd_state) if scfg is None
+                     else (params, state, upd_state, sc_state))
+            carry, (losses, gnorms) = lax.scan(
+                body, carry, inputs,
                 unroll=min(int(xs.shape[0]), unroll, _CHUNK_UNROLL_CAP))
-            return params, state, upd_state, losses, gnorms
+            if scfg is None:
+                params, state, upd_state = carry
+            else:
+                params, state, upd_state, sc_state = carry
+            return params, state, upd_state, sc_state, losses, gnorms
 
         pspec = P()
         cspec = P(None, self.axis)  # [K, B, ...]: shard the batch dim
-        out_specs = (pspec, pspec, pspec, pspec, pspec)
+        out_specs = (pspec, pspec, pspec, pspec, pspec, pspec)
         if has_mask:
             fn = jax.jit(shard_map(
                 shard_chunk, mesh=self.mesh,
-                in_specs=(pspec, pspec, pspec, cspec, cspec, cspec, cspec,
-                          pspec, pspec),
+                in_specs=(pspec, pspec, pspec, pspec, cspec, cspec, cspec,
+                          cspec, pspec, pspec),
                 out_specs=out_specs, check_rep=False))
             return fn
 
-        def no_mask(params, state, upd, xs, ys, ws, it0, lr_scale):
-            return shard_chunk(params, state, upd, xs, ys, ws, None, it0,
-                               lr_scale)
+        def no_mask(params, state, upd, sc, xs, ys, ws, it0, lr_scale):
+            return shard_chunk(params, state, upd, sc, xs, ys, ws, None,
+                               it0, lr_scale)
 
         fn = jax.jit(shard_map(
             no_mask, mesh=self.mesh,
-            in_specs=(pspec, pspec, pspec, cspec, cspec, cspec, pspec,
-                      pspec),
+            in_specs=(pspec, pspec, pspec, pspec, cspec, cspec, cspec,
+                      pspec, pspec),
             out_specs=out_specs, check_rep=False))
-        return lambda p, s, u, xs, ys, ws, masks, it0, lr: fn(
-            p, s, u, xs, ys, ws, it0, lr)
+        return lambda p, s, u, sc, xs, ys, ws, masks, it0, lr: fn(
+            p, s, u, sc, xs, ys, ws, it0, lr)
 
     def fit_chunk_async(self, xs, ys, masks=None, weights=None,
                         unroll: int = 1):
@@ -256,6 +372,7 @@ class DataParallelTrainer:
                 "fit_chunk_async supports the plain synchronous DP path; "
                 "use per-batch fit_batch_async for local-SGD/shard_update")
         net = self.net
+        self._check_policy()
         sh = jax.sharding.NamedSharding(self.mesh, P(None, self.axis))
         put = lambda a: None if a is None else jax.device_put(a, sh)  # noqa: E731
         xs = put(xs)
@@ -275,10 +392,17 @@ class DataParallelTrainer:
             step = self._chunk_step_fn[key] = \
                 self._build_chunk_step(key[0], key[1])
         it0 = self._iteration
-        (net.params, net.state, net.updater_state, losses, gnorms) = step(
-            net.params, net.state, net.updater_state, xs, ys, weights,
-            masks, jnp.asarray(it0, jnp.int32),
+        scfg = net.precision.loss_scale
+        if scfg is not None and net._scaler_state is None:
+            net._scaler_state = init_scaler_state(scfg)
+        sc_state = net._scaler_state if scfg is not None else {}
+        (net.params, net.state, net.updater_state, sc_state, losses,
+         gnorms) = step(
+            net.params, net.state, net.updater_state, sc_state, xs, ys,
+            weights, masks, jnp.asarray(it0, jnp.int32),
             jnp.asarray(net._lr_scale, jnp.float32))
+        if scfg is not None:
+            net._scaler_state = sc_state
         self._iteration += k
         net.last_grad_norm = gnorms[-1]
         net._fire_chunk_listeners(it0, k, losses)
@@ -507,6 +631,7 @@ class DataParallelTrainer:
         averaged every N steps (net.params reflects the average at sync
         points).  Listeners force a host sync only when registered."""
         net = self.net
+        self._check_policy()
         x = np.asarray(x)
         y = np.asarray(y)
         if x.shape[0] % self.n_devices:
@@ -537,10 +662,16 @@ class DataParallelTrainer:
             net.updater_state = None
             net._updater_state_owner = self
         elif self.sync_every == 1:
-            (net.params, net.state, net.updater_state, loss,
+            scfg = net.precision.loss_scale
+            if scfg is not None and net._scaler_state is None:
+                net._scaler_state = init_scaler_state(scfg)
+            sc_state = net._scaler_state if scfg is not None else {}
+            (net.params, net.state, net.updater_state, sc_state, loss,
              net.last_grad_norm) = self._step_fn(
-                net.params, net.state, net.updater_state, xs, ys, rng, ms,
-                scale)
+                net.params, net.state, net.updater_state, sc_state, xs, ys,
+                rng, ms, scale)
+            if scfg is not None:
+                net._scaler_state = sc_state
         else:
             if self._rep is None:
                 self._rep = tuple(self._stack(t) for t in

@@ -28,6 +28,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams (~0.6); accept both so
+# the kernels import on either side of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 NEG_INF = -1e30
 
 # Softmax row-stats (lse, delta) cross the pallas_call boundary in
@@ -169,7 +174,7 @@ def _flash_forward(q, k, v, causal: bool, interpret: bool):
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, s, REP), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(qf, kf, vf)
@@ -326,7 +331,7 @@ def _bwd_block(q, k, v, g, lse, delta, causal: bool, interpret: bool):
                         pltpu.VMEM((bk, d), jnp.float32)],
         # Inner q dim is sequential (scratch accumulation); outer two are
         # independent, letting Mosaic pipeline/parallelize them.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, gf, lse_rep, delta_rep)
@@ -346,7 +351,7 @@ def _bwd_block(q, k, v, g, lse, delta, causal: bool, interpret: bool):
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, jb: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, gf, lse_rep, delta_rep)

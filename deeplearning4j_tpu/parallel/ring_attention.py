@@ -153,7 +153,11 @@ def _ring_cases(causal, my_idx, kv_idx):
 
 def _ring_flash_fwd_pass(q, k, v, axis_name, causal, interpret):
     axis_size = lax.psum(1, axis_name)
-    my_idx = lax.axis_index(axis_name)
+    # Non-causal rings never branch on block position, so don't emit
+    # axis_index at all: the partition-id HLO it lowers to is rejected by
+    # the SPMD partitioner when XLA keeps the shard_map body outlined
+    # (observed on CPU meshes), and an unused carry doesn't DCE it.
+    my_idx = lax.axis_index(axis_name) if causal else jnp.int32(0)
     b, s_local, h, _ = q.shape
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
@@ -169,8 +173,11 @@ def _ring_flash_fwd_pass(q, k, v, axis_name, causal, interpret):
         def full(_):
             return _flash_block_fwd(q, k_blk, v_blk, False, interpret)
 
-        bo, blse = lax.switch(_ring_cases(causal, my_idx, kv_idx),
-                              [skip, diag, full], None)
+        if causal:
+            bo, blse = lax.switch(_ring_cases(causal, my_idx, kv_idx),
+                                  [skip, diag, full], None)
+        else:
+            bo, blse = full(None)
         # lse-weighted combine of normalized outputs (numerically stable:
         # weights are exp of non-positive numbers).
         new_lse = jnp.logaddexp(lse, blse)

@@ -1,0 +1,60 @@
+"""Precision plane: dtype policies, dynamic loss scaling, int8 serving.
+
+The subsystem that owns every numerics decision (ISSUE-5):
+
+- :class:`PrecisionPolicy` — (param_dtype, compute_dtype, output_dtype)
+  threaded through `MultiLayerNetwork`, the layer stack, the fused
+  multi-step driver and the data-parallel trainer.  Named policies:
+  ``"fp32"``, ``"bf16"`` (pure), ``"mixed"`` (fp32 masters + bf16
+  compute + dynamic loss scaling) — `fit(precision=...)`, CLI
+  ``-precision``.
+- :mod:`loss_scale` — the grow/backoff loss-scaling automaton; overflow
+  steps skip the update instead of poisoning the master weights and
+  surface through `scaler_stats()` / the supervisor health path.
+- :mod:`quantize` — per-channel symmetric int8 weight quantization for
+  serving (`ServingEngine(quantize="int8")`, CLI ``serve -quantize``):
+  ~4x smaller resident params, dequantize-in-kernel matmuls, same
+  bucket-ladder compile-count guarantees.
+- byte accounting (`param_bytes` / `train_state_bytes` /
+  `activation_bytes`) — the memory-trajectory columns bench.py records
+  on every row.
+
+See docs/performance.md "The precision cost model".
+"""
+
+from deeplearning4j_tpu.precision.loss_scale import (  # noqa: F401
+    DynamicLossScaler,
+    LossScaleConfig,
+    grads_finite,
+    init_scaler_state,
+    unscale_grads,
+    update_scaler_state,
+    where_tree,
+)
+from deeplearning4j_tpu.precision.policy import (  # noqa: F401
+    PrecisionPolicy,
+    activation_bytes,
+    cast_floating,
+    default_dtype,
+    param_bytes,
+    resolve_policy,
+    train_state_bytes,
+    tree_bytes,
+)
+from deeplearning4j_tpu.precision.quantize import (  # noqa: F401
+    QuantizedNet,
+    dequantize,
+    int8_conv,
+    int8_dense,
+    quantize_net_params,
+    quantize_symmetric,
+)
+
+__all__ = [
+    "PrecisionPolicy", "resolve_policy", "cast_floating", "default_dtype",
+    "param_bytes", "train_state_bytes", "activation_bytes", "tree_bytes",
+    "LossScaleConfig", "DynamicLossScaler", "init_scaler_state",
+    "grads_finite", "unscale_grads", "update_scaler_state", "where_tree",
+    "QuantizedNet", "quantize_symmetric", "dequantize", "int8_dense",
+    "int8_conv", "quantize_net_params",
+]

@@ -1,0 +1,163 @@
+"""Dynamic loss scaling for narrow-dtype training.
+
+Scaling the loss by S before differentiation multiplies every gradient
+by S, lifting tiny backward signals out of the sub-normal floor of
+narrow dtypes; the train step divides the gradients by S before the
+optimizer sees them, so the update is mathematically unchanged — UNLESS
+the scaled backward overflowed.  The dynamic part is the classic
+grow/backoff automaton (fp16 training's standard recipe; bf16 shares
+fp32's exponent range so overflow is rarer, but the same machinery is
+what turns a non-finite gradient from "params poisoned, training dead"
+into "step skipped, scale halved, training continues"):
+
+- every step whose unscaled gradients are all finite counts as *good*;
+  after ``growth_interval`` consecutive good steps the scale doubles
+  (probing for the largest safe scale);
+- a step with any non-finite gradient is an *overflow*: the update is
+  SKIPPED (the jitted step keeps the old params/optimizer state via
+  `jnp.where`), the scale multiplies by ``backoff_factor`` and the
+  good-step counter resets.
+
+State is a tiny pytree of device scalars ({scale, good_steps,
+overflow_count}) so the whole automaton lives INSIDE the jitted train
+step — no host sync, no recompile when the scale changes.  The overflow
+count doubles as the health-path signal: the supervisor (and
+`MultiLayerNetwork.scaler_stats()`) read it to see skipped steps that
+never poisoned the master weights.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+PyTree = Any
+
+
+@dataclass(frozen=True)
+class LossScaleConfig:
+    """Grow/backoff automaton parameters (frozen: hashable jit key)."""
+
+    init_scale: float = 2.0 ** 15
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 200
+    min_scale: float = 1.0
+    max_scale: float = 2.0 ** 24
+
+    def __post_init__(self):
+        if self.init_scale <= 0:
+            raise ValueError(f"init_scale must be > 0, got {self.init_scale}")
+        if not (0.0 < self.backoff_factor < 1.0):
+            raise ValueError(f"backoff_factor must be in (0, 1), got "
+                             f"{self.backoff_factor}")
+        if self.growth_factor <= 1.0:
+            raise ValueError(f"growth_factor must be > 1, got "
+                             f"{self.growth_factor}")
+        if self.growth_interval < 1:
+            raise ValueError(f"growth_interval must be >= 1, got "
+                             f"{self.growth_interval}")
+        if not (0 < self.min_scale <= self.init_scale <= self.max_scale):
+            raise ValueError(
+                f"need min_scale <= init_scale <= max_scale, got "
+                f"{self.min_scale}/{self.init_scale}/{self.max_scale}")
+
+
+def init_scaler_state(cfg: LossScaleConfig) -> Dict[str, Any]:
+    """Device-scalar automaton state; a plain dict pytree so it donates,
+    checkpoints and shards exactly like the optimizer state."""
+    import jax.numpy as jnp
+
+    return {"scale": jnp.asarray(cfg.init_scale, jnp.float32),
+            "good_steps": jnp.zeros((), jnp.int32),
+            "overflow_count": jnp.zeros((), jnp.int32)}
+
+
+def grads_finite(grads: PyTree):
+    """Scalar bool: every element of every leaf is finite.  f32-reduced
+    so a bf16 tree can't overflow the check itself."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    ok = jnp.asarray(True)
+    for leaf in leaves:
+        ok = jnp.logical_and(
+            ok, jnp.all(jnp.isfinite(jnp.asarray(leaf).astype(jnp.float32))))
+    return ok
+
+
+def unscale_grads(grads: PyTree, scale) -> PyTree:
+    """grads / scale, preserving each leaf's dtype (one reciprocal, then
+    a broadcast multiply per leaf — cheap next to the backward)."""
+    import jax
+    import jax.numpy as jnp
+
+    inv = (1.0 / scale).astype(jnp.float32)
+    return jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+
+
+def update_scaler_state(cfg: LossScaleConfig, state: Dict[str, Any],
+                        finite) -> Dict[str, Any]:
+    """One automaton transition (jit-safe: pure `jnp.where` arithmetic).
+
+    finite -> good_steps += 1; at growth_interval the scale multiplies
+    by growth_factor (clamped to max_scale) and the counter resets.
+    overflow -> scale *= backoff_factor (clamped to min_scale),
+    counter resets, overflow_count += 1."""
+    import jax.numpy as jnp
+
+    scale = state["scale"]
+    good = jnp.where(finite, state["good_steps"] + 1, 0)
+    grown = jnp.where(
+        good >= cfg.growth_interval,
+        jnp.minimum(scale * cfg.growth_factor, cfg.max_scale), scale)
+    good = jnp.where(good >= cfg.growth_interval, 0, good)
+    backed = jnp.maximum(scale * cfg.backoff_factor, cfg.min_scale)
+    return {"scale": jnp.where(finite, grown, backed),
+            "good_steps": good,
+            "overflow_count": state["overflow_count"]
+            + jnp.where(finite, 0, 1).astype(jnp.int32)}
+
+
+def where_tree(cond, a: PyTree, b: PyTree) -> PyTree:
+    """Leafwise `jnp.where(cond, a, b)` — the skip-the-update select: on
+    overflow the step emits the OLD params/optimizer/layer state
+    unchanged, so a non-finite gradient can never poison the masters."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.where(cond, x, y), a, b)
+
+
+class DynamicLossScaler:
+    """Host-side convenience wrapper over the functional automaton —
+    what unit tests and interactive use drive; the jitted train steps
+    use the functions directly so the state stays on device."""
+
+    def __init__(self, cfg: LossScaleConfig = LossScaleConfig()):
+        self.cfg = cfg
+        self.state = init_scaler_state(cfg)
+
+    @property
+    def scale(self) -> float:
+        return float(self.state["scale"])
+
+    @property
+    def overflow_count(self) -> int:
+        return int(self.state["overflow_count"])
+
+    def observe(self, finite: bool) -> float:
+        """Feed one step's finiteness verdict; returns the new scale."""
+        self.state = update_scaler_state(self.cfg, self.state, finite)
+        return self.scale
+
+    def check_and_update(self, grads: PyTree) -> Tuple[PyTree, bool]:
+        """Unscale `grads`, transition on their finiteness; returns
+        (unscaled_grads, finite)."""
+        gs = unscale_grads(grads, self.state["scale"])
+        finite = bool(grads_finite(gs))
+        self.observe(finite)
+        return gs, finite

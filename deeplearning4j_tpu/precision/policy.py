@@ -1,0 +1,231 @@
+"""PrecisionPolicy: the one object that owns every dtype decision.
+
+Before this module, dtype assumptions were smeared across the stack —
+initializers took a `dtype`, the forward read `conf.compute_dtype`,
+pipelines hardcoded float32, checkpoints hardcoded float32 — so changing
+the numerics of a net meant touching six subsystems.  The policy object
+centralizes them:
+
+    PrecisionPolicy(param_dtype, compute_dtype, output_dtype)
+
+- ``param_dtype``: what the optimizer holds (the "master" weights).
+- ``compute_dtype``: what the forward/backward matmuls run in.  On TPU
+  the MXU's native rate is bf16; halving activation/gradient bytes is a
+  direct bandwidth win (PAPERS.md: SIMD-convolution anatomy — effective
+  vector width is the first-order dense-kernel throughput lever).
+- ``output_dtype``: what `output()`/serving hand back to callers.
+- ``loss_scale``: a `LossScaleConfig` enables the dynamic loss scaler in
+  the train step (grow/backoff on overflow, overflowed steps skip the
+  update instead of poisoning the master weights).
+
+Three named policies cover the useful points of the design space:
+
+    "fp32"   — everything float32 (the pre-precision-plane behavior).
+    "bf16"   — pure bf16: params, compute and gradients all bf16.  Half
+               the train-state bytes of fp32 across the board; fine for
+               SGD-style training of small nets, risky for long Adam
+               runs (update-to-weight ratios below bf16's ~2^-8 relative
+               step silently stall).
+    "mixed"  — fp32 master weights + bf16 compute + fp32 loss/grad-norm
+               accumulation + dynamic loss scaling: the production
+               recipe (what every serious TPU trainer runs).
+
+Resolution accepts a policy object, a name, or None (meaning: derive
+from the net's `NeuralNetConfiguration.dtype/compute_dtype`, which keeps
+every existing conf working unchanged).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.precision.loss_scale import LossScaleConfig
+
+PyTree = Any
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """Dtype policy for one network; frozen so it can key jit caches."""
+
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"
+    output_dtype: str = "float32"
+    loss_scale: Optional[LossScaleConfig] = None
+
+    def __post_init__(self):
+        for field in ("param_dtype", "compute_dtype", "output_dtype"):
+            name = getattr(self, field)
+            try:
+                dt = np.dtype(name)
+            except TypeError as e:
+                raise ValueError(f"{field}={name!r} is not a dtype") from e
+            if dt.kind != "f" and str(dt) != "bfloat16":
+                raise ValueError(
+                    f"{field}={name!r} must be a floating dtype "
+                    f"(int8 belongs to the serving quantizer, not the "
+                    f"training policy)")
+
+    # ---- construction ------------------------------------------------------
+
+    @classmethod
+    def named(cls, name: str) -> "PrecisionPolicy":
+        try:
+            return dict(
+                fp32=cls(),
+                float32=cls(),
+                bf16=cls(param_dtype="bfloat16", compute_dtype="bfloat16"),
+                bfloat16=cls(param_dtype="bfloat16",
+                             compute_dtype="bfloat16"),
+                mixed=cls(param_dtype="float32", compute_dtype="bfloat16",
+                          loss_scale=LossScaleConfig()),
+            )[name.lower()]
+        except KeyError:
+            raise ValueError(
+                f"unknown precision policy {name!r}; named policies: "
+                f"fp32, bf16, mixed") from None
+
+    @classmethod
+    def from_conf(cls, conf) -> "PrecisionPolicy":
+        """Derive the policy a `NeuralNetConfiguration` declares — the
+        back-compat path every existing conf flows through."""
+        return cls(param_dtype=conf.dtype,
+                   compute_dtype=conf.compute_dtype,
+                   output_dtype=getattr(conf, "output_dtype", "float32"))
+
+    def with_loss_scale(self, cfg: Optional[LossScaleConfig]
+                        ) -> "PrecisionPolicy":
+        return dataclasses.replace(self, loss_scale=cfg)
+
+    # ---- derived views -----------------------------------------------------
+
+    @property
+    def input_dtype(self) -> np.dtype:
+        """The dtype pipelines should coerce features to: param dtype for
+        pure-narrow policies (halves host->device bytes), float32 for
+        fp32/mixed (inputs keep full precision; the forward casts)."""
+        return np.dtype(self.param_dtype)
+
+    @property
+    def is_mixed(self) -> bool:
+        return self.param_dtype != self.compute_dtype
+
+    def describe(self) -> str:
+        scale = "+loss-scale" if self.loss_scale is not None else ""
+        return (f"param={self.param_dtype}/compute={self.compute_dtype}/"
+                f"out={self.output_dtype}{scale}")
+
+
+def resolve_policy(policy, conf=None) -> PrecisionPolicy:
+    """Accept a PrecisionPolicy, a named policy string, or None (derive
+    from `conf` when given, else fp32)."""
+    if policy is None:
+        return (PrecisionPolicy.from_conf(conf) if conf is not None
+                else PrecisionPolicy())
+    if isinstance(policy, PrecisionPolicy):
+        return policy
+    if isinstance(policy, str):
+        return PrecisionPolicy.named(policy)
+    raise TypeError(f"precision must be a PrecisionPolicy, a policy name "
+                    f"or None, got {type(policy).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# dtype casting + byte accounting (shared by the net, bench and serving)
+
+
+def cast_floating(tree: PyTree, dtype) -> PyTree:
+    """Cast floating leaves of a pytree to `dtype`, leaving integer
+    leaves (embedding ids, step counters) untouched.  No-op trees pass
+    through unchanged when dtype is float32 AND every leaf already is —
+    cheap identity for the default policy."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(dtype)
+
+    def cast(a):
+        a = jnp.asarray(a)
+        return a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) \
+            else a
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def tree_bytes(tree: PyTree) -> int:
+    """Total bytes of every array leaf (device or host) of a pytree."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = np.asarray(leaf) if not hasattr(leaf, "dtype") else leaf
+        total += int(np.prod(np.shape(a))) * np.dtype(a.dtype).itemsize
+    return total
+
+
+def param_bytes(net_or_tree) -> int:
+    """Resident parameter bytes — of a params pytree, a
+    MultiLayerNetwork, or a quantized serving wrapper (which reports its
+    int8 + scale + bias footprint)."""
+    own = getattr(net_or_tree, "param_bytes", None)
+    if callable(own) and not isinstance(net_or_tree, (list, dict, tuple)):
+        return int(own())
+    params = getattr(net_or_tree, "params", net_or_tree)
+    return tree_bytes(params)
+
+
+def activation_bytes(net, x, mask=None) -> int:
+    """Bytes of every intermediate activation of one forward at the
+    policy's compute dtype — the live-tensor term of the training-memory
+    model (dominant at real batch sizes)."""
+    acts = net.feed_forward(np.asarray(x), mask)
+    itemsize = np.dtype(net.precision.compute_dtype).itemsize
+    return sum(int(np.prod(np.shape(a))) * itemsize for a in acts)
+
+
+def train_state_bytes(net, x=None, mask=None) -> int:
+    """The steady-state training-memory model of one step:
+
+        master params (param_dtype) + optimizer state (as held)
+        + gradients (compute_dtype, one per param)
+        + activations (compute_dtype, when an example batch is given).
+
+    This is the quantity the bf16-mixed policy halves: master weights
+    stay fp32, but gradients and activations — which dominate at real
+    batch sizes — shrink to 2 bytes each."""
+    params = net.params if net.params is not None else []
+    n_params = sum(int(np.prod(np.shape(a)))
+                   for p in params for a in p.values())
+    total = tree_bytes(params)
+    if net.updater_state is not None:
+        total += tree_bytes(net.updater_state)
+    total += n_params * np.dtype(net.precision.compute_dtype).itemsize
+    if x is not None:
+        total += activation_bytes(net, x, mask)
+    return total
+
+
+def default_dtype(obj=None) -> np.dtype:
+    """The dtype a pipeline/data-prep stage should coerce features to.
+
+    With no argument: the framework default (float32).  With a
+    MultiLayerNetwork / MultiLayerConfiguration / NeuralNetConfiguration
+    / PrecisionPolicy: that object's declared input dtype — so a
+    pure-bf16 net's pipeline feeds bf16 instead of silently upcasting
+    every batch to 4-byte floats."""
+    if obj is None:
+        return np.dtype(np.float32)
+    if isinstance(obj, PrecisionPolicy):
+        return obj.input_dtype
+    policy = getattr(obj, "precision", None)          # MultiLayerNetwork
+    if isinstance(policy, PrecisionPolicy):
+        return policy.input_dtype
+    conf = getattr(obj, "conf", obj)                   # MultiLayerConfiguration
+    conf = getattr(conf, "conf", conf)                 # nested .conf
+    if hasattr(conf, "dtype"):
+        return PrecisionPolicy.from_conf(conf).input_dtype
+    return np.dtype(np.float32)

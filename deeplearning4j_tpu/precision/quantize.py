@@ -1,0 +1,237 @@
+"""Int8 weight-quantized inference.
+
+Serving replicas hold the same weights forever; training precision is
+wasted on them.  Per-channel symmetric int8 quantization stores each
+dense/conv weight matrix as int8 plus one float32 scale per OUTPUT
+channel — a ~4x reduction in resident parameter bytes (the replicated
+cost the cross-replica weight-update-sharding paper treats as the thing
+to cut, applied to the serving plane) and a matching cut in the
+weight-streaming bandwidth that single-request inference is bound by.
+
+Numerics: for a per-output-channel scale s[j],
+
+    x @ dequant(Q)  ==  (x @ Q) * s        (exactly, in the compute dtype)
+
+so the kernels below run the matmul/conv on the int8 weights cast to the
+compute dtype and apply the scale to the (much smaller) output — the
+"dequantize-in-kernel" form: no float copy of the weight matrix ever
+materializes, the int8->compute cast fuses into the matmul's operand
+read.
+
+`QuantizedNet` wraps a trained `MultiLayerNetwork` for inference only:
+dense/output/rnn-output/convolution layers run the int8 kernels,
+everything else (batch-norm, pooling, activations, LSTM) runs its normal
+apply on the original float params.  It exposes the same
+`output`/`output_bucketed`/`forward_program_count` surface the
+`ServingEngine` drives, so the bucket ladder and the compile-count guard
+hold unchanged — one compiled program per (bucket shape, policy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+PyTree = Any
+
+# Layer type tags whose {W, b} params take the int8 dense/conv kernels.
+_DENSE_TAGS = ("denselayer", "outputlayer", "rnnoutputlayer")
+_CONV_TAGS = ("convolutionlayer",)
+
+
+def quantize_symmetric(w, axis: int = -1) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-channel symmetric int8 quantization along `axis` (the output-
+    channel axis).  Returns (q int8, scale float32) with
+    dequant = q * scale broadcast over `axis`.  Symmetric (zero-point 0)
+    keeps the matmul a plain integer-weight contraction."""
+    w = np.asarray(w, np.float32)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis % w.ndim)
+    amax = np.max(np.abs(w), axis=reduce_axes, keepdims=True)
+    scale = (amax / 127.0).astype(np.float32)
+    scale = np.where(scale == 0.0, np.float32(1.0), scale)  # all-zero channel
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return q, np.squeeze(scale, axis=reduce_axes).astype(np.float32)
+
+
+def dequantize(q: np.ndarray, scale: np.ndarray, axis: int = -1
+               ) -> np.ndarray:
+    """Reference dequantization (tests/debugging; the serving kernels
+    never materialize this)."""
+    q = np.asarray(q, np.float32)
+    shape = [1] * q.ndim
+    shape[axis % q.ndim] = -1
+    return q * np.asarray(scale, np.float32).reshape(shape)
+
+
+def int8_dense(x, q, scale, b, compute_dtype):
+    """y = (x @ Q) * s + b with the int8->compute cast fused into the
+    matmul operand read; exact per-output-channel dequantization."""
+    import jax.numpy as jnp
+
+    ct = jnp.dtype(compute_dtype)
+    if x.ndim == 3:  # [B, T, in] sequence head
+        z = jnp.einsum("bti,io->bto", x.astype(ct), q.astype(ct))
+    else:
+        z = x.astype(ct) @ q.astype(ct)
+    return z * scale.astype(ct) + b.astype(ct)
+
+
+def int8_conv(x, q, scale, b, compute_dtype, strides, padding):
+    """NHWC conv on int8 HWIO weights cast in-kernel; per-output-channel
+    scale applied to the [B, H, W, cout] result."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    ct = jnp.dtype(compute_dtype)
+    w = q.astype(ct)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    z = lax.conv_general_dilated(x.astype(ct), w, window_strides=strides,
+                                 padding=padding, dimension_numbers=dn)
+    return z * scale.astype(ct) + b.astype(ct)
+
+
+def quantize_net_params(net) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """Quantize every eligible layer of a MultiLayerNetwork's params.
+
+    Returns (qparams, kinds) aligned with the layer stack: eligible
+    layers get {"W_q": int8, "W_scale": f32[cout], "b": f32} and kind
+    "dense"/"conv"; everything else keeps its original float params with
+    kind "passthrough"."""
+    import jax.numpy as jnp
+
+    qparams: List[Dict[str, Any]] = []
+    kinds: List[str] = []
+    for lc, p in zip(net.conf.layers, net.params):
+        tag = lc.type_tag()
+        if tag in _DENSE_TAGS + _CONV_TAGS and "W" in p:
+            q, s = quantize_symmetric(np.asarray(p["W"]), axis=-1)
+            qparams.append({"W_q": jnp.asarray(q),
+                            "W_scale": jnp.asarray(s),
+                            "b": jnp.asarray(np.asarray(p["b"], np.float32))})
+            kinds.append("dense" if tag in _DENSE_TAGS else "conv")
+        else:
+            qparams.append({k: jnp.asarray(v) for k, v in p.items()})
+            kinds.append("passthrough")
+    return qparams, kinds
+
+
+class QuantizedNet:
+    """Inference-only int8-weight view of a MultiLayerNetwork.
+
+    Drives the SAME serving surface as the float net (`output`,
+    `output_bucketed`, `forward_program_count`), so `ServingEngine` can
+    swap it in behind the bucket ladder without touching the batcher or
+    the compile-count guard.  Quantization error is bounded per channel
+    (|w - dequant(q)| <= scale/2 = amax/254), which keeps argmax
+    agreement with the float net at the ~99%+ level the acceptance row
+    pins (`bench.py` precision row; tests/test_precision.py)."""
+
+    def __init__(self, net, dtype: str = "int8",
+                 compute_dtype: Optional[str] = None):
+        if dtype != "int8":
+            raise ValueError(f"unsupported quantization dtype {dtype!r} "
+                             f"(int8 only)")
+        if net.params is None:
+            net.init()
+        self.net = net
+        self.dtype = dtype
+        self.compute_dtype = (compute_dtype if compute_dtype is not None
+                              else net.precision.compute_dtype)
+        self.qparams, self.kinds = quantize_net_params(net)
+        self.quantized_layers = sum(k != "passthrough" for k in self.kinds)
+        self._jit_forward = None
+
+    # ---- forward -----------------------------------------------------------
+
+    def _forward(self, qparams, state, x, mask):
+        """Mirror of MultiLayerNetwork._forward (inference branch) with
+        int8 kernels on the quantized layers."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.models.multi_layer_network import (
+            apply_preprocessor,
+        )
+        from deeplearning4j_tpu.nn.layers.common import activate
+
+        net = self.net
+        ct = jnp.dtype(self.compute_dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(ct)
+        for i, (lc, kind) in enumerate(zip(net.conf.layers, self.kinds)):
+            if str(i) in net.conf.input_preprocessors:
+                x = apply_preprocessor(net.conf.input_preprocessors[str(i)],
+                                       x)
+            p = qparams[i]
+            if kind == "dense":
+                x = activate(lc, int8_dense(x, p["W_q"], p["W_scale"],
+                                            p["b"], ct))
+            elif kind == "conv":
+                x = activate(lc, int8_conv(x, p["W_q"], p["W_scale"],
+                                           p["b"], ct, lc.stride,
+                                           lc.padding))
+            else:
+                is_rnn_layer = x.ndim == 3
+                fp = net._cast_floating(p, ct)
+                x, _ = net.impls[i].apply(
+                    lc, fp, state[i], x, train=False, rng=None,
+                    mask=mask if is_rnn_layer else None)
+        return x.astype(jnp.dtype(net.precision.output_dtype))
+
+    def output(self, x, mask=None):
+        import jax
+        import jax.numpy as jnp
+
+        if self._jit_forward is None:
+            self._jit_forward = jax.jit(
+                lambda qp, s, x, mask: self._forward(qp, s, x, mask))
+        return self._jit_forward(self.qparams, self.net.state,
+                                 jnp.asarray(x), mask)
+
+    def output_bucketed(self, x, mask=None, ladder=None) -> np.ndarray:
+        """Pad-up-the-ladder dispatch + host-side row slice — identical
+        discipline to MultiLayerNetwork.output_bucketed (a device-side
+        `out[:n]` would compile a slice program per distinct n)."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.serving.bucketing import BucketLadder
+
+        if ladder is None:
+            ladder = BucketLadder()
+        x = np.asarray(x)
+        padded, n = ladder.pad_rows(x)
+        if mask is not None:
+            mask, _ = ladder.pad_rows(np.asarray(mask))
+            mask = jnp.asarray(mask)
+        out = np.asarray(self.output(padded, mask))
+        return out if n == padded.shape[0] else out[:n]
+
+    def predict(self, x, mask=None) -> np.ndarray:
+        return np.asarray(np.argmax(np.asarray(self.output(x, mask)),
+                                    axis=-1))
+
+    def forward_program_count(self) -> int:
+        if self._jit_forward is None:
+            return 0
+        return int(self._jit_forward._cache_size())
+
+    # ---- accounting --------------------------------------------------------
+
+    def param_bytes(self) -> int:
+        """Resident serving parameter bytes: int8 weights + f32 scales +
+        f32 biases + any passthrough float params."""
+        from deeplearning4j_tpu.precision.policy import tree_bytes
+
+        return tree_bytes(self.qparams)
+
+    def quantization_report(self) -> Dict[str, Any]:
+        from deeplearning4j_tpu.precision.policy import tree_bytes
+
+        return {
+            "dtype": self.dtype,
+            "quantized_layers": self.quantized_layers,
+            "total_layers": len(self.kinds),
+            "param_bytes": self.param_bytes(),
+            "float_param_bytes": tree_bytes(self.net.params),
+        }

@@ -45,7 +45,15 @@ def _flatten_with_paths(tree: PyTree):
     out = {}
     for path, leaf in flat:
         key = _SEP.join(_path_piece(p) for p in path)
-        out[key] = np.asarray(leaf)
+        a = np.asarray(leaf)
+        if str(a.dtype) == "bfloat16":
+            # np.savez cannot round-trip ml_dtypes leaves (they reload
+            # as raw void and refuse to cast); store them as float32 —
+            # EXACT for bf16 — and let `npz_to_tree`'s cast-to-like
+            # restore the narrow dtype on load.  Keeps the npz readable
+            # by vanilla numpy, at 4 bytes/param on disk.
+            a = a.astype(np.float32)
+        out[key] = a
     return out
 
 
@@ -121,6 +129,14 @@ def save_model(net, directory: os.PathLike, *, save_updater: bool = False
         tree_to_npz(directory / "updater.npz", upd)
     meta = {"format": 1, "num_params": int(net.num_params()),
             "saved_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    policy = getattr(net, "precision", None)
+    if policy is not None:
+        meta["param_dtype"] = str(np.dtype(policy.param_dtype))
+        meta["precision"] = {
+            "param_dtype": str(np.dtype(policy.param_dtype)),
+            "compute_dtype": str(np.dtype(policy.compute_dtype)),
+            "output_dtype": str(np.dtype(policy.output_dtype)),
+        }
     (directory / "meta.json").write_text(json.dumps(meta, indent=2))
     return directory
 
@@ -128,12 +144,31 @@ def save_model(net, directory: os.PathLike, *, save_updater: bool = False
 def load_model(directory: os.PathLike):
     """Rebuild a MultiLayerNetwork from conf.json + params.npz — the
     `MultiLayerNetwork(conf, params)` ctor of the reference. Restores
-    updater state too when `updater.npz` is present."""
+    updater state too when `updater.npz` is present, and the saved
+    precision policy when meta.json records one the conf does not
+    declare (a net whose precision was overridden via `set_precision`
+    after construction round-trips at its live dtypes; the dynamic
+    loss-scale config is training-only and not persisted — re-enable
+    with `fit(precision=...)` when resuming training)."""
+    import dataclasses
+
     from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.precision import resolve_policy
 
     directory = pathlib.Path(directory)
     net = MultiLayerNetwork.from_json(
         (directory / "conf.json").read_text())
+    meta_path = directory / "meta.json"
+    if meta_path.exists():
+        meta = json.loads(meta_path.read_text())
+        saved = meta.get("precision")
+        if saved is None and meta.get("param_dtype") is not None:
+            saved = {"param_dtype": meta["param_dtype"]}  # older meta
+        if saved is not None:
+            policy = dataclasses.replace(
+                resolve_policy(None, net.conf.conf), **saved)
+            if policy != net.precision:
+                net.set_precision(policy)
     net.init()
     net.params = npz_to_tree(directory / "params.npz", net.params)
     if (directory / "updater.npz").exists():
@@ -142,24 +177,68 @@ def load_model(directory: os.PathLike):
     return net
 
 
-def save_params(net, path: os.PathLike, mode: str = "binary") -> None:
-    """Flat param vector dump (CLI parity: Nd4j.write / writeTxt)."""
-    vec = net.params_flat().astype(np.float32)
+def _params_meta_path(path: pathlib.Path) -> pathlib.Path:
+    return path.with_name(path.name + ".meta.json")
+
+
+def save_params(net, path: os.PathLike, mode: str = "binary",
+                dtype=None) -> None:
+    """Flat param vector dump (CLI parity: Nd4j.write / writeTxt).
+
+    The vector is written in the net's NATIVE param dtype (a bf16 net
+    ships 2 bytes/param) with the dtype recorded so `load_params` can
+    restore it — binary mode writes a `<file>.meta.json` sidecar
+    ({dtype, count}; the raw file stays headerless and readable outside
+    this framework), txt mode records it in a `# dtype: ...` comment
+    header (np.loadtxt skips comments, so the file stays loadable
+    anywhere).  `dtype` overrides (e.g. `np.float32` to force the
+    historical all-f32 format)."""
+    vec = net.params_flat(dtype=dtype)   # dtype=None -> native param dtype
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     if mode == "binary":
         vec.tofile(path)
+        _params_meta_path(path).write_text(json.dumps(
+            {"format": 1, "dtype": str(vec.dtype), "count": int(vec.size)}))
     elif mode == "txt":
-        np.savetxt(path, vec)
+        # np.savetxt cannot format narrow floats — values print via f32
+        # (exact for bf16), the header records the true dtype.
+        np.savetxt(path, vec.astype(np.float32),
+                   header=f"dtype: {vec.dtype}")
     else:
         raise ValueError(f"unknown savemode {mode!r} (binary|txt)")
 
 
+def _txt_header_dtype(path: pathlib.Path):
+    """dtype recorded in a txt dump's comment header; None for legacy
+    files without one."""
+    with open(path) as f:
+        first = f.readline()
+    if first.startswith("#") and "dtype:" in first:
+        return np.dtype(first.split("dtype:", 1)[1].strip())
+    return None
+
+
 def load_params(net, path: os.PathLike, mode: str = "binary") -> None:
+    """Restore a flat param dump, honoring the recorded dtype (sidecar
+    meta for binary, comment header for txt); legacy dumps without
+    either load as float32, exactly as before."""
+    path = pathlib.Path(path)
     if mode == "binary":
-        vec = np.fromfile(path, dtype=np.float32)
+        dt = np.dtype(np.float32)
+        meta_path = _params_meta_path(path)
+        if meta_path.exists():
+            try:
+                dt = np.dtype(json.loads(meta_path.read_text())["dtype"])
+            except (ValueError, KeyError, TypeError) as e:
+                raise ValueError(
+                    f"corrupt params meta sidecar {meta_path}: {e}") from e
+        vec = np.fromfile(path, dtype=dt)
     elif mode == "txt":
+        dt = _txt_header_dtype(path)
         vec = np.loadtxt(path, dtype=np.float32).reshape(-1)
+        if dt is not None and dt != np.float32:
+            vec = vec.astype(dt)
     else:
         raise ValueError(f"unknown savemode {mode!r} (binary|txt)")
     net.set_params_flat(vec)

@@ -30,6 +30,15 @@ Three cooperating pieces live here:
   `MultiLayerNetwork` and the plain-sync `DataParallelTrainer` implement
   it.
 
+Precision plane: the runner's `PrecisionPolicy` rides inside
+`fit_chunk_async` — under a loss-scaled policy (e.g. "mixed") the
+scaler automaton is part of the scan carry, so a poison batch
+mid-chunk skips only ITS step (masters stay clean, the scale backs
+off) and the chunk's loss vector reports the non-finite loss for the
+supervisor's per-step health checks, exactly like the per-batch path.
+Chunk assembly is dtype-preserving: stacked batches keep the dtype the
+pipeline fed (a bf16-input net stages 2-byte chunks).
+
 Chunk-size invariance: every step inside a chunk runs the SAME
 example-weighted objective with the same per-iteration RNG fold-in, so
 `chunk_size=1` and `chunk_size=K` execute identical per-step programs

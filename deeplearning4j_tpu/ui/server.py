@@ -530,12 +530,15 @@ class UiServer:
                     max_queue_depth: Optional[int] = None,
                     default_deadline_s: Optional[float] = None,
                     breaker_threshold: Optional[int] = 5,
-                    breaker_cooldown_s: float = 1.0) -> "UiServer":
+                    breaker_cooldown_s: float = 1.0,
+                    quantize: Optional[str] = None) -> "UiServer":
         """Register a MultiLayerNetwork behind the dynamic micro-batcher
         for POST /model/predict.  `warmup_example` (one example row) pre-
         compiles every bucket-ladder shape before traffic.
         `max_queue_depth`, `default_deadline_s` and the breaker knobs
-        configure the serving-plane resilience layer."""
+        configure the serving-plane resilience layer; `quantize="int8"`
+        serves per-channel int8 weights (precision plane,
+        docs/performance.md)."""
         from deeplearning4j_tpu.serving import ServingEngine
 
         engine = ServingEngine(net, ladder=ladder, max_batch=max_batch,
@@ -543,7 +546,8 @@ class UiServer:
                                max_queue_depth=max_queue_depth,
                                default_deadline_s=default_deadline_s,
                                breaker_threshold=breaker_threshold,
-                               breaker_cooldown_s=breaker_cooldown_s)
+                               breaker_cooldown_s=breaker_cooldown_s,
+                               quantize=quantize)
         if warmup_example is not None:
             engine.warmup(warmup_example)
         with self.state.lock:

@@ -34,6 +34,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "serving: serving-engine tests — micro-batcher, bucket "
         "ladder, continuous LM decode (fast; run in tier-1)")
+    config.addinivalue_line(
+        "markers", "precision: precision-plane invariants — bf16 mixed "
+        "parity/determinism, loss-scaler overflow recovery, int8 serving "
+        "agreement, dtype round-trips (fast; run in tier-1)")
 
 
 @pytest.fixture

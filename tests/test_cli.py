@@ -239,11 +239,14 @@ def test_train_runs_greedy_pretraining_for_dbn(tmp_path, capsys,
     assert calls, "CLI train must run greedy pretraining for pretrain confs"
 
 
+@pytest.mark.slow  # ~35s: two full CLI mesh trainings back to back
 def test_lm_mesh_runtimes_match_each_other(tmp_path, capsys):
     """`-runtime hybrid` (dp/sp/tp) and `-runtime pipeline` (dp/pp) both
     train end-to-end through the CLI on the 8-device mesh, save in the
     standard layout, and — same seed, same data order — land on the
-    same final loss."""
+    same final loss.  The single-runtime boot/train paths stay in tier-1
+    via `test_lm_mesh_runtime_single_device` and the runtime-specific
+    trainer equivalence tests; this pairwise A/B is the long gate."""
     text = tmp_path / "corpus.txt"
     text.write_text("the quick brown fox jumps over the lazy dog. " * 60)
     finals = {}

@@ -168,7 +168,7 @@ class TestRecompileGuard:
         net = MultiLayerNetwork(iris_mlp()).init()
         driver = FusedTrainingDriver(net, chunk_size=4, prefetch=0)
         driver.fit(_batches(x, y), epochs=1)
-        chunk_fn = net._jit_train_chunk[(False, 1)]
+        chunk_fn = net._jit_train_chunk[(False, 1, False)]
         assert chunk_fn._cache_size() == 2  # [4,...] + [1,...] programs
 
         compiles = []

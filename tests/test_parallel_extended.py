@@ -362,6 +362,10 @@ def _single_device_adam_steps(cfg, tokens, targets, lr, n_steps, seed):
     return params, losses
 
 
+@pytest.mark.slow  # ~38s pair: each compiles a full mesh trainer AND its
+# single-device Adam reference.  The SGD-reference equivalence for the
+# same trainers (TestHybridParallelTrainer / TestPipelineParallelTrainer)
+# stays in tier-1; this adds the Adam-state-sharding axis.
 class TestTrainerUpdaters:
     """updater='adam' on the mesh trainers must match single-device Adam
     step for step (the optimizer state shards/replicates with its

@@ -1,0 +1,545 @@
+"""Precision-plane invariants (ISSUE-5 acceptance, `precision` marker).
+
+1. bf16-mixed training reaches within tolerance of fp32 on a small net
+   and is bitwise deterministic across reruns.
+2. The dynamic loss scaler: overflow steps skip the update (masters
+   never poisoned), the scale backs off and regrows, and injected
+   inf/nan gradients (chaos harness) surface through the health path
+   instead of killing training.
+3. Policy changes don't multiply compiled programs per bucket (the
+   recompile-count guard via jax.monitoring).
+4. Checkpoint dtype round-trip for fp32, bf16 and quantized nets.
+5. Int8 weight-quantized serving: top-1 agreement with fp32, bounded
+   quantization error, compile-count guard intact, >=3.5x param bytes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayerConf,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayerConf,
+)
+from deeplearning4j_tpu.precision import (
+    DynamicLossScaler,
+    LossScaleConfig,
+    PrecisionPolicy,
+    QuantizedNet,
+    default_dtype,
+    dequantize,
+    param_bytes,
+    quantize_symmetric,
+    resolve_policy,
+    train_state_bytes,
+)
+
+pytestmark = pytest.mark.precision
+
+
+def _iris_conf(updater="adam", seed=0, **kw):
+    return MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=0.05, updater=updater,
+                                    seed=seed, **kw),
+        layers=(DenseLayerConf(n_in=4, n_out=16, activation="relu"),
+                OutputLayerConf(n_in=16, n_out=3)))
+
+
+def _toy_data(n=96, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 3, n)
+    x = rng.normal(0, 0.25, (n, 4)).astype(np.float32) + y[:, None]
+    return x.astype(np.float32), np.eye(3, dtype=np.float32)[y]
+
+
+def _mlp_conf(width=128, seed=5):
+    return MultiLayerConfiguration(
+        conf=NeuralNetConfiguration(learning_rate=0.01, updater="adam",
+                                    seed=seed),
+        layers=(DenseLayerConf(n_in=784, n_out=width, activation="relu"),
+                DenseLayerConf(n_in=width, n_out=width, activation="relu"),
+                OutputLayerConf(n_in=width, n_out=10)))
+
+
+def _flat(net):
+    return np.concatenate([np.asarray(v, np.float32).reshape(-1)
+                           for p in net.params for k, v in sorted(p.items())])
+
+
+# ---------------------------------------------------------------------------
+# policy resolution / threading
+
+
+def test_named_policies():
+    fp32 = resolve_policy("fp32")
+    assert fp32 == PrecisionPolicy() and fp32.loss_scale is None
+    bf16 = resolve_policy("bf16")
+    assert bf16.param_dtype == bf16.compute_dtype == "bfloat16"
+    mixed = resolve_policy("mixed")
+    assert mixed.param_dtype == "float32"
+    assert mixed.compute_dtype == "bfloat16"
+    assert mixed.loss_scale is not None
+    with pytest.raises(ValueError):
+        resolve_policy("fp8")
+    with pytest.raises(ValueError):
+        PrecisionPolicy(param_dtype="int8")
+
+
+def test_policy_derived_from_conf_and_override():
+    net = MultiLayerNetwork(_iris_conf(compute_dtype="bfloat16"))
+    assert net.precision.compute_dtype == "bfloat16"
+    assert net.precision.loss_scale is None  # conf-derived: no scaler
+    net.set_precision("mixed")
+    assert net.precision.loss_scale is not None
+
+
+def test_set_precision_casts_masters_and_reinits_moments():
+    net = MultiLayerNetwork(_iris_conf()).init()
+    x, y = _toy_data()
+    net.fit_batch(x, y)
+    net.set_precision("bf16")
+    for p in net.params:
+        for v in p.values():
+            assert v.dtype == jnp.bfloat16
+    # one step in the new dtype must run clean
+    assert np.isfinite(net.fit_batch(x, y))
+
+
+def test_default_dtype_helper():
+    assert default_dtype() == np.float32
+    assert default_dtype(resolve_policy("mixed")) == np.float32
+    assert str(default_dtype(resolve_policy("bf16"))) == "bfloat16"
+    net = MultiLayerNetwork(_iris_conf())
+    assert default_dtype(net) == np.float32
+    assert default_dtype(_iris_conf()) == np.float32
+
+
+# ---------------------------------------------------------------------------
+# 1. bf16-mixed parity + determinism
+
+
+def test_mixed_tracks_fp32_and_masters_stay_f32():
+    x, y = _toy_data()
+    f32 = MultiLayerNetwork(_iris_conf()).init()
+    mixed = MultiLayerNetwork(_iris_conf()).init().set_precision("mixed")
+    l_f = [float(f32.fit_batch(x, y)) for _ in range(60)][-1]
+    l_m = [float(mixed.fit_batch(x, y)) for _ in range(60)][-1]
+    for p in mixed.params:
+        for v in p.values():
+            assert v.dtype == jnp.float32  # fp32 masters
+    # documented tolerance (docs/performance.md): small-net final-loss
+    # gap under bf16 compute
+    assert abs(l_f - l_m) < 0.05
+    assert mixed.evaluate(x, y).accuracy() > 0.9
+    stats = mixed.scaler_stats()
+    assert stats["overflow_count"] == 0 and stats["scale"] >= 1.0
+
+
+def test_mixed_bitwise_deterministic_across_reruns():
+    x, y = _toy_data()
+
+    def run():
+        net = MultiLayerNetwork(_iris_conf()).init()
+        net.set_precision("mixed")
+        for _ in range(25):
+            net.fit_batch(x, y)
+        return _flat(net)
+
+    a, b = run(), run()
+    assert a.tobytes() == b.tobytes()
+
+
+def test_pure_bf16_trains_and_halves_param_bytes():
+    x, y = _toy_data()
+    f32 = MultiLayerNetwork(_iris_conf()).init()
+    bf16 = MultiLayerNetwork(_iris_conf()).init().set_precision("bf16")
+    for _ in range(40):
+        bf16.fit_batch(x, y)
+    assert bf16.evaluate(x, y).accuracy() > 0.8
+    assert param_bytes(f32) == 2 * param_bytes(bf16)
+
+
+def test_train_state_bytes_mixed_reduction():
+    """The memory model the bench row records: with activations and
+    gradients at bf16 and activations dominating (real batch sizes),
+    bf16-mixed cuts train-state bytes by ~2x despite fp32 masters."""
+    x, y = _toy_data(n=4096)
+    f32 = MultiLayerNetwork(_iris_conf()).init()
+    mixed = MultiLayerNetwork(_iris_conf()).init().set_precision("mixed")
+    f32.fit_batch(x, y)
+    mixed.fit_batch(x, y)
+    ratio = train_state_bytes(f32, x) / train_state_bytes(mixed, x)
+    assert ratio >= 1.9, ratio
+
+
+# ---------------------------------------------------------------------------
+# 2. dynamic loss scaler
+
+
+def test_scaler_automaton_unit():
+    cfg = LossScaleConfig(init_scale=16.0, growth_factor=2.0,
+                          backoff_factor=0.5, growth_interval=3,
+                          min_scale=1.0, max_scale=64.0)
+    sc = DynamicLossScaler(cfg)
+    assert sc.scale == 16.0
+    sc.observe(True)
+    sc.observe(True)
+    assert sc.scale == 16.0  # not yet at the interval
+    sc.observe(True)
+    assert sc.scale == 32.0  # grew after 3 good steps
+    sc.observe(False)
+    assert sc.scale == 16.0 and sc.overflow_count == 1
+    for _ in range(12):
+        sc.observe(False)
+    assert sc.scale == cfg.min_scale  # clamped
+    for _ in range(30):
+        sc.observe(True)
+    assert sc.scale == cfg.max_scale  # clamped high
+
+
+def test_scaler_config_validation():
+    with pytest.raises(ValueError):
+        LossScaleConfig(backoff_factor=1.5)
+    with pytest.raises(ValueError):
+        LossScaleConfig(growth_factor=0.5)
+    with pytest.raises(ValueError):
+        LossScaleConfig(init_scale=0.5, min_scale=1.0)
+
+
+def test_overflow_skips_update_and_feeds_health_path():
+    """Chaos-injected poison batch (NaN features, the harness's
+    poison-batch path): the update is SKIPPED — master weights bitwise
+    unchanged — the scale backs off, and the non-finite grad norm is
+    visible to the supervisor's health monitor."""
+    from deeplearning4j_tpu.resilience.chaos import (
+        ChaosConfig,
+        ChaosDataSource,
+    )
+    from deeplearning4j_tpu.resilience.health import (
+        HealthAction,
+        HealthMonitor,
+    )
+
+    x, y = _toy_data()
+    batches = [(x, y, None)] * 4
+    src = ChaosDataSource(batches, ChaosConfig(nan_steps=[2]))
+    net = MultiLayerNetwork(_iris_conf()).init().set_precision("mixed")
+    monitor = HealthMonitor(min_history=1)
+    snapshots, verdicts = [], []
+    for step, (bx, by, _) in enumerate(src):
+        snapshots.append(_flat(net))
+        loss = float(net.fit_batch(bx, by))
+        gnorm = float(net.last_grad_norm)
+        verdicts.append(monitor.observe(step, loss, gnorm)[0])
+    # the poison step (index 2) left the params exactly as they were
+    after_poison = np.concatenate(
+        [snapshots[3], np.zeros(0, np.float32)])
+    assert snapshots[2].tobytes() == after_poison.tobytes()
+    # ... and the health monitor SAW it (non-finite signal)
+    assert verdicts[2] is HealthAction.ROLLBACK
+    assert verdicts[3] is HealthAction.OK  # clean next step
+    stats = net.scaler_stats()
+    assert stats["overflow_count"] == 1
+    assert stats["scale"] == LossScaleConfig().init_scale * 0.5
+
+
+def test_overflow_mid_chunk_skips_only_that_step():
+    x, y = _toy_data()
+    k = 4
+    xs = np.broadcast_to(x, (k,) + x.shape).copy()
+    ys = np.broadcast_to(y, (k,) + y.shape).copy()
+    xs[2] = np.inf
+    chunked = MultiLayerNetwork(_iris_conf()).init().set_precision("mixed")
+    losses, gnorms = chunked.fit_chunk_async(xs, ys)
+    losses = np.asarray(losses)
+    assert not np.isfinite(losses[2])          # the poison step reported
+    assert np.isfinite(losses[[0, 1, 3]]).all()
+    assert all(np.isfinite(np.asarray(v)).all()
+               for p in chunked.params for v in p.values())
+    assert chunked.scaler_stats()["overflow_count"] == 1
+    # per-batch replay of the same schedule (poison step skipped both
+    # ways) lands on the same masters: chunked == per-batch under the
+    # scaler, the fused-driver invariant extended to the precision plane
+    stepped = MultiLayerNetwork(_iris_conf()).init().set_precision("mixed")
+    for i in range(k):
+        stepped.fit_batch(xs[i], ys[i])
+    assert _flat(chunked).tobytes() == _flat(stepped).tobytes()
+
+
+def test_accum_plus_loss_scale_rejected():
+    net = MultiLayerNetwork(_iris_conf()).init().set_precision("mixed")
+    x, y = _toy_data(8)
+    with pytest.raises(ValueError, match="accum"):
+        net.fit_batch(x, y, accum_steps=2)
+
+
+# ---------------------------------------------------------------------------
+# 3. recompile-count guard
+
+
+def _count_compiles(fn):
+    events = []
+
+    def listener(event, *a, **kw):
+        if "compile" in event and "backend" in event:
+            events.append(event)
+
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(listener)
+    try:
+        fn()
+    finally:
+        jax.monitoring.clear_event_listeners()
+    return len(events)
+
+
+def test_policy_change_does_not_multiply_programs():
+    """One compiled train program per (shape, policy): switching the
+    policy compiles ONCE more; further steps under either policy hit
+    the cache (no per-step recompiles)."""
+    x, y = _toy_data()
+    net = MultiLayerNetwork(_iris_conf()).init()
+    net.fit_batch(x, y)                    # fp32 program compiled
+    net.set_precision("mixed")
+    net.fit_batch(x, y)                    # mixed program compiled
+
+    def steady():
+        for _ in range(5):
+            net.fit_batch(x, y)
+
+    assert _count_compiles(steady) == 0
+
+
+def test_quantized_serving_compile_count_bounded():
+    """Mixed-batch-size storm against an int8 engine after warmup: zero
+    new compiles, program count pinned at the ladder bound."""
+    from deeplearning4j_tpu.serving import BucketLadder, ServingEngine
+
+    net = MultiLayerNetwork(_mlp_conf(width=32)).init()
+    engine = ServingEngine(net, ladder=BucketLadder((1, 4, 8)),
+                           max_wait_ms=0.5, quantize="int8")
+    try:
+        engine.warmup(np.zeros((784,), np.float32))
+        rng = np.random.default_rng(0)
+
+        def storm():
+            for n in (1, 2, 3, 4, 5, 7, 8, 1, 6):
+                engine.predict_proba(
+                    rng.random((n, 784)).astype(np.float32))
+
+        assert _count_compiles(storm) == 0
+        assert engine.stats()["compiled_programs"] <= 3
+        assert engine._model().forward_program_count() <= 3
+    finally:
+        engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# 4. checkpoint dtype round-trip (fp32 / bf16 / quantized)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_params_dump_roundtrip(tmp_path, dtype):
+    from deeplearning4j_tpu.runtime.checkpoint import (
+        load_params,
+        save_params,
+    )
+
+    conf = _iris_conf(dtype=dtype, compute_dtype=dtype)
+    net = MultiLayerNetwork(conf).init()
+    for mode, name in (("binary", "p.bin"), ("txt", "p.txt")):
+        save_params(net, tmp_path / name, mode=mode)
+        other = MultiLayerNetwork(conf).init(jax.random.PRNGKey(99))
+        load_params(other, tmp_path / name, mode=mode)
+        for p, q in zip(net.params, other.params):
+            for k in p:
+                assert q[k].dtype == jnp.dtype(dtype)
+                assert (np.asarray(p[k]) == np.asarray(q[k])).all()
+    # narrow dtypes ship narrow: the binary dump is 2 bytes/param
+    expect = np.dtype(dtype).itemsize * net.num_params()
+    assert (tmp_path / "p.bin").stat().st_size == expect
+
+
+def test_legacy_f32_dump_still_loads(tmp_path):
+    """A headerless float32 dump (pre-precision-plane format, no meta
+    sidecar) must keep loading as float32."""
+    from deeplearning4j_tpu.runtime.checkpoint import load_params
+
+    net = MultiLayerNetwork(_iris_conf()).init()
+    vec = net.params_flat()
+    (tmp_path / "legacy.bin").write_bytes(vec.astype(np.float32).tobytes())
+    other = MultiLayerNetwork(_iris_conf()).init(jax.random.PRNGKey(7))
+    load_params(other, tmp_path / "legacy.bin", mode="binary")
+    assert (other.params_flat() == vec).all()
+
+
+def test_model_dir_roundtrip_bf16(tmp_path):
+    from deeplearning4j_tpu.runtime.checkpoint import load_model, save_model
+
+    conf = _iris_conf(dtype="bfloat16", compute_dtype="bfloat16")
+    net = MultiLayerNetwork(conf).init()
+    save_model(net, tmp_path / "m")
+    net2 = load_model(tmp_path / "m")
+    assert net2.params[0]["W"].dtype == jnp.bfloat16
+    a = net.params_flat(dtype=None)
+    b = net2.params_flat(dtype=None)
+    assert a.dtype == b.dtype and (a == b).all()
+
+
+def test_quantized_net_survives_save_load(tmp_path):
+    """Quantization is a pure function of the float params, so a
+    reloaded net quantizes to bitwise-identical int8 weights and
+    byte-identical predictions."""
+    from deeplearning4j_tpu.runtime.checkpoint import load_model, save_model
+
+    net = MultiLayerNetwork(_mlp_conf(width=32)).init()
+    x, y = np.random.default_rng(0).random((64, 784), np.float32), None
+    save_model(net, tmp_path / "m")
+    q1 = QuantizedNet(net)
+    q2 = QuantizedNet(load_model(tmp_path / "m"))
+    for p1, p2, k1, k2 in zip(q1.qparams, q2.qparams, q1.kinds, q2.kinds):
+        assert k1 == k2
+        for k in p1:
+            assert (np.asarray(p1[k]) == np.asarray(p2[k])).all()
+    o1 = np.asarray(q1.output(x))
+    o2 = np.asarray(q2.output(x))
+    assert o1.tobytes() == o2.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# 5. int8 quantization numerics + serving parity
+
+
+def test_quantize_symmetric_error_bound():
+    rng = np.random.default_rng(0)
+    w = rng.normal(0, 0.3, (64, 32)).astype(np.float32)
+    q, s = quantize_symmetric(w, axis=-1)
+    assert q.dtype == np.int8 and s.shape == (32,)
+    err = np.abs(dequantize(q, s) - w)
+    # symmetric rounding: error <= scale/2 per channel
+    assert (err <= s[None, :] / 2 + 1e-7).all()
+    # all-zero channel must not divide by zero
+    w[:, 3] = 0.0
+    q, s = quantize_symmetric(w, axis=-1)
+    assert (dequantize(q, s)[:, 3] == 0).all()
+
+
+def test_int8_dense_matches_dequantized_matmul():
+    from deeplearning4j_tpu.precision import int8_dense
+
+    rng = np.random.default_rng(1)
+    w = rng.normal(0, 0.2, (16, 8)).astype(np.float32)
+    b = rng.normal(0, 0.1, (8,)).astype(np.float32)
+    x = rng.normal(0, 1, (4, 16)).astype(np.float32)
+    q, s = quantize_symmetric(w)
+    got = np.asarray(int8_dense(jnp.asarray(x), jnp.asarray(q),
+                                jnp.asarray(s), jnp.asarray(b), "float32"))
+    want = x @ dequantize(q, s) + b
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_int8_serving_topk_agreement_and_bytes():
+    """The acceptance pair: >=99% top-1 agreement with the float net on
+    an mnist_mlp-shaped classifier and >=3.5x resident param-byte
+    reduction; conv nets (lenet) get the same check in the bench row."""
+    from deeplearning4j_tpu.serving import BucketLadder, ServingEngine
+
+    net = MultiLayerNetwork(_mlp_conf(width=64)).init()
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 10, 256)
+    x = rng.normal(0, 0.3, (256, 784)).astype(np.float32)
+    x[np.arange(256), y * 78] += 3.0       # separable synthetic classes
+    yh = np.eye(10, dtype=np.float32)[y]
+    for _ in range(15):
+        net.fit_batch(x, yh)
+
+    engine = ServingEngine(net, ladder=BucketLadder((1, 8, 32)),
+                           max_wait_ms=0.5, quantize="int8")
+    try:
+        engine.warmup(np.zeros((784,), np.float32))
+        test = rng.normal(0, 0.3, (128, 784)).astype(np.float32)
+        test[np.arange(128), (np.arange(128) % 10) * 78] += 3.0
+        got = engine.predict_proba(test[:32]).argmax(-1)
+        want = np.asarray(net.output(test[:32])).argmax(-1)
+        agreement = (got == want).mean()
+        assert agreement >= 0.99, agreement
+        rep = engine.stats()["quantization"]
+        assert rep["float_param_bytes"] / rep["param_bytes"] >= 3.5
+    finally:
+        engine.stop()
+
+
+def test_quantized_conv_net():
+    """Conv weights quantize per output channel through the int8 conv
+    kernel; lenet-digits argmax agreement stays high."""
+    from deeplearning4j_tpu.models.zoo import lenet_digits
+
+    net = MultiLayerNetwork(lenet_digits()).init()
+    rng = np.random.default_rng(0)
+    x = rng.random((16, 8, 8, 1)).astype(np.float32)
+    q = QuantizedNet(net)
+    assert q.quantized_layers == 4          # 2 conv + dense + output
+    out_q = np.asarray(q.output(x))
+    out_f = np.asarray(net.output(x))
+    assert out_q.shape == out_f.shape
+    np.testing.assert_allclose(out_q, out_f, atol=0.05, rtol=0.1)
+    assert (out_q.argmax(-1) == out_f.argmax(-1)).mean() >= 0.9
+
+
+def test_quantized_bucketed_slice_identity():
+    """output_bucketed pads up the ladder and slices rows back: real
+    rows byte-identical to an unpadded dispatch (same contract as the
+    float net's serving path)."""
+    from deeplearning4j_tpu.serving.bucketing import BucketLadder
+
+    net = MultiLayerNetwork(_mlp_conf(width=32)).init()
+    q = QuantizedNet(net)
+    ladder = BucketLadder((4, 8))
+    rng = np.random.default_rng(0)
+    x = rng.random((3, 784)).astype(np.float32)
+    got = q.output_bucketed(x, ladder=ladder)
+    assert got.shape[0] == 3
+    padded = np.concatenate([x, np.zeros((1, 784), np.float32)])
+    want = np.asarray(q.output(padded))[:3]
+    assert got.tobytes() == want.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# data-parallel mixed precision (8-device virtual mesh)
+
+
+def test_mixed_under_data_parallel_with_overflow():
+    from deeplearning4j_tpu.parallel import DataParallelTrainer
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    x, y = _toy_data(n=64)
+    net = MultiLayerNetwork(_iris_conf()).init().set_precision("mixed")
+    trainer = DataParallelTrainer(net)
+    assert np.isfinite(trainer.fit_batch(x, y))
+    before = _flat(net)
+    trainer.fit_batch(np.full_like(x, np.inf), y)
+    assert _flat(net).tobytes() == before.tobytes()   # lockstep skip
+    assert net.scaler_stats()["overflow_count"] == 1
+    assert np.isfinite(trainer.fit_batch(x, y))
+    for p in net.params:
+        for v in p.values():
+            assert v.dtype == jnp.float32
+
+
+def test_loss_scale_rejected_off_plain_sync_path():
+    from deeplearning4j_tpu.parallel import DataParallelTrainer
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    net = MultiLayerNetwork(_iris_conf()).init().set_precision("mixed")
+    with pytest.raises(ValueError, match="loss-scaled"):
+        DataParallelTrainer(net, sync_every=4)
+    with pytest.raises(ValueError, match="loss-scaled"):
+        DataParallelTrainer(net, shard_update=True)

@@ -1,8 +1,8 @@
 # Convenience entry points. Everything here is reproducible by hand —
 # the targets just spell the one-liners out.
 
-.PHONY: test test-serving test-precision dryrun bench smoke serving-smoke \
-	bench-precision evidence lint
+.PHONY: test test-serving test-precision test-fleet dryrun bench smoke \
+	serving-smoke bench-precision bench-fleet evidence lint
 
 test:
 	python -m pytest tests/ -x -q
@@ -10,6 +10,16 @@ test:
 # Serving subsystem only (micro-batcher, bucket ladder, continuous LM).
 test-serving:
 	python -m pytest tests/ -q -m serving
+
+# Serving-fleet only (failover router, health ejection/re-admission,
+# rolling weight swaps, fleet chaos).
+test-fleet:
+	python -m pytest tests/ -q -m fleet
+
+# Fleet bench row: concurrency-32 storm with a replica killed mid-storm
+# (requests/s, p99, failed must be 0).
+bench-fleet:
+	BENCH_ONLY=servingfleet python bench.py
 
 # Broad-except linter (see docs/robustness.md): fails on new bare
 # `except Exception:` in deeplearning4j_tpu/ without a noqa pragma.
@@ -28,9 +38,9 @@ smoke:
 	BENCH_ONLY=lenet,transformer python bench.py
 
 # Serving throughput rows only (micro-batched classifier + continuous LM
-# + the overload/admission-control row).
+# + the overload/admission-control row + the fleet mid-storm-kill row).
 serving-smoke:
-	BENCH_ONLY=serving,servinglm,servingoverload python bench.py
+	BENCH_ONLY=serving,servinglm,servingoverload,servingfleet python bench.py
 
 # Precision-plane tests only (bf16-mixed parity/determinism, loss-scaler
 # overflow recovery, int8 serving agreement, dtype round-trips).

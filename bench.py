@@ -1167,6 +1167,99 @@ def bench_serving_overload() -> dict:
                     "completed work keeps the bounded queue's p99"}
 
 
+def bench_serving_fleet() -> dict:
+    """Fleet row (ISSUE-6 acceptance): a concurrency-32 storm against a
+    3-replica serving fleet with one replica HARD-KILLED mid-storm.
+    Predict is pure, so the router resubmits every dispatch that died
+    with the replica on a surviving one — the row's acceptance bar is
+    `failed == 0`: a replica death costs failovers (counted) but zero
+    failed requests.  Reports completed requests/s and the p99 of the
+    storm (which absorbs the kill + failover transient)."""
+    import threading
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork, mnist_mlp
+    from deeplearning4j_tpu.serving import (
+        BucketLadder,
+        FleetRouter,
+        spawn_local_replica,
+    )
+
+    conc = 32
+    total = conc * max(8, STEPS // 10)
+    replicas = 3
+    kill_after = total // 3
+    net = MultiLayerNetwork(mnist_mlp()).init()
+    rng = np.random.default_rng(0)
+    reqs = [rng.random((1, 784)).astype(np.float32) for _ in range(total)]
+    warm = np.zeros((784,), np.float32)
+
+    def one_storm():
+        def factory(name):
+            return spawn_local_replica(
+                name, net, ladder=BucketLadder((1, 8, 16, 32)),
+                max_wait_ms=2.0, warmup_example=warm)
+
+        router = FleetRouter(factory, replicas=replicas,
+                             request_timeout_s=120.0)
+        lock = threading.Lock()
+        state = {"done": 0, "failed": 0, "killed": False}
+
+        def handler(x):
+            try:
+                router.predict_proba(x, timeout=120)
+            except Exception:  # noqa: BLE001 — the row COUNTS failures
+                with lock:
+                    state["failed"] += 1
+                return
+            with lock:
+                state["done"] += 1
+                kill = state["done"] >= kill_after and not state["killed"]
+                if kill:
+                    state["killed"] = True
+            if kill:
+                router.replicas()[0].kill()   # mid-storm replica death
+
+        try:
+            sec = _serving_storm(conc, reqs, handler)
+            stats = router.fleet_stats(include_replica_stats=False)
+        finally:
+            router.stop()
+        lat = stats["fleet"].get("latency", {})
+        return {"sec": sec, "failed": state["failed"],
+                "p99_ms": lat.get("p99_ms"),
+                "failovers": stats["fleet"]["failovers"],
+                "routable": stats["fleet"]["replicas_routable"]}
+
+    # best-of-2: same thread-scheduling-noise policy as the other
+    # serving rows (each leg builds its own fleet, so the kill replays).
+    # Throughput comes from the faster leg, but the failed==0 acceptance
+    # gate must hold across BOTH legs — a leg that dropped requests is a
+    # failed kill replay even when the other leg happened to be faster.
+    runs = [one_storm() for _ in range(2)]
+    run = min(runs, key=lambda r: r["sec"])
+    failed_all_legs = sum(r["failed"] for r in runs)
+    ok = total - run["failed"]
+    return {"metric": "MLP-classifier serving fleet under a mid-storm "
+                      f"replica kill (concurrency {conc}, "
+                      f"{replicas} replicas)",
+            "unit": "requests/sec",
+            "value": round(ok / run["sec"], 1),
+            "concurrency": conc, "requests": total,
+            "replicas": replicas, "killed_replicas": 1,
+            "kill_after_requests": kill_after,
+            "failed": run["failed"],
+            "failed_all_legs": failed_all_legs,
+            "failovers": run["failovers"],
+            "replicas_routable_after": run["routable"],
+            "p99_ms": run["p99_ms"],
+            **_mem_fields(net=net),
+            "model": "mnist-mlp 784-2048-2048-10",
+            "meets_acceptance": failed_all_legs == 0,
+            "note": "predict is pure, so dispatches that died with the "
+                    "replica were resubmitted on survivors — a replica "
+                    "death costs failovers, never failed requests"}
+
+
 def bench_serving_lm() -> dict:
     """Continuous LM decode (slot pool, prompts join mid-flight) vs the
     pre-serving behavior: concurrent requests served one-at-a-time, each
@@ -1279,6 +1372,7 @@ BENCHES = {
     "serving": bench_serving,
     "servinglm": bench_serving_lm,
     "servingoverload": bench_serving_overload,
+    "servingfleet": bench_serving_fleet,
     "precision": bench_precision,
     "flashab": bench_flash_ab,
     "longctx": bench_longctx,

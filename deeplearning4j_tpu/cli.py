@@ -482,6 +482,112 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_serve_fleet(args) -> int:
+    """Serve a saved model through a replicated fleet: N thread-hosted
+    `dl4j serve`-equivalent replicas behind a `FleetRouter` (least-loaded
+    + failover dispatch, /readyz-driven health ejection with half-open
+    re-admission, optional queue-depth autoscale) fronted by one
+    `FleetServer` endpoint.  SIGTERM drains the WHOLE fleet gracefully
+    and snapshots /fleet/stats (deeplearning4j_tpu/serving/fleet.py;
+    docs/robustness.md "The serving fleet")."""
+    import signal
+    import threading
+
+    from deeplearning4j_tpu.nn.conf import DenseLayerConf
+    from deeplearning4j_tpu.serving import (
+        BucketLadder,
+        FleetRouter,
+        FleetServer,
+        spawn_local_replica,
+    )
+
+    if not args.model:
+        raise SystemExit("serve-fleet needs -model")
+    if args.replicas < 1:
+        raise SystemExit(f"-replicas must be >= 1, got {args.replicas}")
+    net = _build_net(args.model)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    max_queue = args.max_queue if args.max_queue > 0 else None
+    deadline_s = args.deadline_ms / 1e3 if args.deadline_ms > 0 else None
+    breaker_n = (args.breaker_threshold if args.breaker_threshold > 0
+                 else None)
+    quantize = args.quantize if args.quantize != "none" else None
+    first = net.conf.layers[0]
+    # same flat-input rule as cmd_serve: a [b, n_in] warmup batch only
+    # makes sense for dense stacks
+    flat = isinstance(first, DenseLayerConf) and first.n_in
+    warmup_example = (np.zeros((int(first.n_in),), np.float32)
+                     if args.warmup and flat else None)
+    if args.warmup and not flat:
+        print("serve-fleet: -warmup skipped (non-flat input layer "
+              f"{type(first).__name__}); the first request per bucket "
+              "compiles instead")
+
+    def factory(name: str):
+        ladder = BucketLadder(buckets)
+        return spawn_local_replica(
+            name, net, host=args.host, ladder=ladder,
+            max_batch=min(args.max_batch, ladder.max_batch),
+            max_wait_ms=args.max_wait_ms, warmup_example=warmup_example,
+            max_queue_depth=max_queue, default_deadline_s=deadline_s,
+            breaker_threshold=breaker_n, quantize=quantize)
+
+    router = FleetRouter(
+        factory, replicas=args.replicas,
+        min_replicas=min(args.min_replicas, args.replicas),
+        max_replicas=max(args.max_replicas, args.replicas),
+        health_interval_s=args.health_interval_s)
+    router.autoscale = bool(args.autoscale)
+    front = FleetServer(router, host=args.host, port=args.port).start()
+    router.start_health_loop()
+    names = ", ".join(r.name for r in router.replicas())
+    print(f"serve-fleet: {args.replicas} warm replicas in rotation "
+          f"({names}); health every {args.health_interval_s}s; "
+          f"autoscale {'on' if args.autoscale else 'off'} "
+          f"[{router.min_replicas}, {router.max_replicas}]")
+    print(f"Serving fleet on {front.url} — POST /model/predict; "
+          f"GET /fleet/stats, /serving/stats, /healthz, /readyz")
+
+    # SIGTERM -> fleet-wide graceful drain: the front stops admission
+    # (503 + /readyz not-ready), every replica drains its in-flight
+    # work, and the final /fleet/stats — per-replica breakdown plus the
+    # aggregated ledger — is snapshotted to disk.
+    term = threading.Event()
+    installed = prev = None
+    if threading.current_thread() is threading.main_thread():
+        prev = signal.signal(signal.SIGTERM, lambda *_: term.set())
+        installed = True
+    try:
+        if args.serve_seconds > 0:
+            term.wait(args.serve_seconds)
+        else:
+            while not term.wait(3600):
+                pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if term.is_set():
+            print(f"serve-fleet: SIGTERM — draining fleet (grace "
+                  f"{args.drain_grace_s}s)")
+            drained = front.drain(args.drain_grace_s)
+            stats_path = pathlib.Path(args.drain_stats)
+            try:
+                stats_path.write_text(json.dumps(
+                    router.fleet_stats(), indent=2))
+                where = str(stats_path)
+            except OSError as e:
+                # a lost snapshot must not leave the fleet unstopped or
+                # the signal handler unrestored
+                where = f"LOST ({e})"
+            print(f"serve-fleet: drain "
+                  f"{'complete' if drained else 'grace expired'}; stats "
+                  f"snapshot -> {where}")
+        front.stop()
+        if installed:
+            signal.signal(signal.SIGTERM, prev)
+    return 0
+
+
 def cmd_lm(args) -> int:
     """Train the flagship TransformerLM on a raw text file (byte-level
     vocab, causal LM) and/or generate from a saved one — the CLI surface
@@ -926,6 +1032,70 @@ def build_parser() -> argparse.ArgumentParser:
                          help="stop after this many seconds (0 = run "
                               "until interrupted)")
     p_serve.set_defaults(fn=cmd_serve)
+
+    p_fleet = sub.add_parser(
+        "serve-fleet", help="serve a saved model through N replicated "
+        "engines behind a failover router with health ejection and "
+        "fleet-wide SIGTERM drain")
+    p_fleet.add_argument("-model", "--model", required=True,
+                         help="saved model dir, conf JSON, or zoo:<name>")
+    p_fleet.add_argument("-replicas", "--replicas", type=int, default=2,
+                         help="replicas spawned into rotation (default 2)")
+    p_fleet.add_argument("-host", "--host", default="127.0.0.1")
+    p_fleet.add_argument("-port", "--port", type=int, default=8080,
+                         help="fleet front port (0 = ephemeral); each "
+                              "replica gets its own ephemeral port")
+    p_fleet.add_argument("-max-batch", "--max-batch", dest="max_batch",
+                         type=int, default=32,
+                         help="per-replica max coalesced batch")
+    p_fleet.add_argument("-max-wait-ms", "--max-wait-ms",
+                         dest="max_wait_ms", type=float, default=2.0,
+                         help="per-replica idle coalescing window")
+    p_fleet.add_argument("-buckets", "--buckets", default="1,8,32",
+                         help="per-replica batch bucket ladder")
+    p_fleet.add_argument("-warmup", "--warmup", action="store_true",
+                         help="pre-compile every bucket shape per "
+                              "replica before it enters rotation")
+    p_fleet.add_argument("-quantize", "--quantize",
+                         choices=["none", "int8"], default="none",
+                         help="per-replica int8 weight quantization")
+    p_fleet.add_argument("-max-queue", "--max-queue", dest="max_queue",
+                         type=int, default=256,
+                         help="per-replica admission bound, matching "
+                              "the serve default: queued requests past "
+                              "this depth are refused with HTTP 503 + "
+                              "Retry-After (0 = unbounded)")
+    p_fleet.add_argument("-deadline-ms", "--deadline-ms",
+                         dest="deadline_ms", type=float, default=0,
+                         help="per-replica default request deadline "
+                              "(0 = none)")
+    p_fleet.add_argument("-breaker-threshold", "--breaker-threshold",
+                         dest="breaker_threshold", type=int, default=5,
+                         help="per-replica engine circuit-breaker "
+                              "threshold (0 = off)")
+    p_fleet.add_argument("-health-interval-s", "--health-interval-s",
+                         dest="health_interval_s", type=float, default=1.0,
+                         help="router /readyz poll interval")
+    p_fleet.add_argument("-autoscale", "--autoscale",
+                         action="store_true",
+                         help="queue-depth-driven scale up/down through "
+                              "graceful drain")
+    p_fleet.add_argument("-min-replicas", "--min-replicas",
+                         dest="min_replicas", type=int, default=1)
+    p_fleet.add_argument("-max-replicas", "--max-replicas",
+                         dest="max_replicas", type=int, default=8)
+    p_fleet.add_argument("-drain-grace-s", "--drain-grace-s",
+                         dest="drain_grace_s", type=float, default=5.0,
+                         help="fleet-wide SIGTERM drain grace window")
+    p_fleet.add_argument("-drain-stats", "--drain-stats",
+                         dest="drain_stats", default="fleet_stats.json",
+                         help="where the final /fleet/stats snapshot is "
+                              "written on SIGTERM drain")
+    p_fleet.add_argument("-serve-seconds", "--serve-seconds",
+                         dest="serve_seconds", type=float, default=0,
+                         help="stop after this many seconds (0 = run "
+                              "until interrupted)")
+    p_fleet.set_defaults(fn=cmd_serve_fleet)
 
     p_test = sub.add_parser("test", help="evaluate a saved model")
     common(p_test)

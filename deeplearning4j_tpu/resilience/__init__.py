@@ -26,9 +26,11 @@ keep going:
 from deeplearning4j_tpu.resilience.chaos import (
     ChaosConfig,
     ChaosDataSource,
+    FleetChaosConfig,
     InjectedDispatchFault,
     ServingChaosConfig,
     chaos_dispatch,
+    chaos_fleet,
     chaos_runner,
 )
 from deeplearning4j_tpu.resilience.faults import (
@@ -54,9 +56,11 @@ from deeplearning4j_tpu.resilience.watchdog import StepWatchdog
 __all__ = [
     "ChaosConfig",
     "ChaosDataSource",
+    "FleetChaosConfig",
     "InjectedDispatchFault",
     "ServingChaosConfig",
     "chaos_dispatch",
+    "chaos_fleet",
     "chaos_runner",
     "FaultReport",
     "PreemptedError",

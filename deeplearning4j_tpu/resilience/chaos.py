@@ -28,6 +28,15 @@ dispatches fire at configured indices (drives overload/deadline
 shedding), and any request whose rows are entirely `poison_value` fails
 its dispatch (drives poison-request bisection) — all deterministic, all
 CPU-only, so every serving recovery path runs in tier-1.
+
+The serving FLEET (ISSUE-6) gets fleet-level faults: `chaos_fleet`
+wraps a `FleetRouter`'s dispatch and readyz-probe hooks so a replica is
+killed at a configured dispatch-attempt index (drives failover
+resubmission — the mid-storm kill that must cost zero failed requests),
+dispatches are slowed at configured indices (drives load-skew /
+autoscale), and readyz probes lie at configured poll indices (drives
+eject -> half-open probe -> re-admit without killing anything) — again
+deterministic, counter-driven, CPU-only.
 """
 
 from __future__ import annotations
@@ -192,3 +201,107 @@ def chaos_dispatch(dispatch, config: ServingChaosConfig):
     `ServingEngine.batcher`).  The wrapper counts calls on ``.calls`` so
     tests can assert how many device dispatches actually happened."""
     return _ChaosDispatch(dispatch, config)
+
+
+# ---------------------------------------------------------------------------
+# Fleet-level fault injection (ISSUE-6)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetChaosConfig:
+    """Fleet faults, keyed by deterministic counters.
+
+    - ``kill_at_attempt``: just before dispatch attempt #N (0-based,
+      router-wide) the victim replica is hard-killed.  Default victim is
+      the replica that attempt targets — the most adversarial choice:
+      the request in hand MUST fail over.  ``kill_replica`` names a
+      specific victim instead.
+    - ``slow_attempt_steps``: the dispatch attempt sleeps
+      ``slow_seconds`` first (when ``slow_replica`` is set, only
+      attempts routed to that replica sleep) — drives load skew, spill
+      routing and autoscale.
+    - ``flaky_readyz_polls``: per-replica probe indices (0-based, in
+      poll order) at which the readyz probe reports not-ready even
+      though the replica is fine — the flapping-readyz fault that
+      drives eject -> half-open probe -> re-admit.  ``flaky_replica``
+      restricts it to one replica (None = every replica flaps at those
+      indices).
+    """
+
+    kill_at_attempt: Optional[int] = None
+    kill_replica: Optional[str] = None
+    slow_attempt_steps: Sequence[int] = ()
+    slow_seconds: float = 0.05
+    slow_replica: Optional[str] = None
+    flaky_readyz_polls: Sequence[int] = ()
+    flaky_replica: Optional[str] = None
+
+
+class _FleetChaos:
+    """Installed over a `FleetRouter`'s `_dispatch` / `_probe_readyz`
+    hooks (instance attributes shadow the methods).  Counters:
+    ``attempts`` (dispatch attempts seen), ``probes`` (readyz probes per
+    replica name), ``killed`` (victim names, in kill order)."""
+
+    def __init__(self, router, config: FleetChaosConfig):
+        import threading
+
+        self.router = router
+        self.config = config
+        self.attempts = 0
+        self.probes: dict = {}
+        self.killed: list = []
+        self._lock = threading.Lock()
+        self._orig_dispatch = router._dispatch
+        self._orig_probe = router._probe_readyz
+        router._dispatch = self._dispatch
+        router._probe_readyz = self._probe
+
+    def uninstall(self) -> None:
+        self.router._dispatch = self._orig_dispatch
+        self.router._probe_readyz = self._orig_probe
+
+    def _victim(self, replica):
+        cfg = self.config
+        if cfg.kill_replica is None:
+            return replica
+        for r in self.router.replicas():
+            if r.name == cfg.kill_replica:
+                return r
+        return None
+
+    def _dispatch(self, replica, path, body, timeout=None):
+        cfg = self.config
+        with self._lock:
+            i = self.attempts
+            self.attempts += 1
+            kill = (cfg.kill_at_attempt == i
+                    and cfg.kill_replica not in self.killed)
+        if kill:
+            victim = self._victim(replica)
+            if victim is not None:
+                victim.kill()
+                with self._lock:
+                    self.killed.append(victim.name)
+        if (i in cfg.slow_attempt_steps
+                and cfg.slow_replica in (None, replica.name)):
+            time.sleep(cfg.slow_seconds)
+        return self._orig_dispatch(replica, path, body, timeout)
+
+    def _probe(self, replica) -> bool:
+        with self._lock:
+            n = self.probes.get(replica.name, 0)
+            self.probes[replica.name] = n + 1
+        cfg = self.config
+        if (n in cfg.flaky_readyz_polls
+                and cfg.flaky_replica in (None, replica.name)):
+            return False
+        return self._orig_probe(replica)
+
+
+def chaos_fleet(router, config: FleetChaosConfig) -> _FleetChaos:
+    """Install deterministic fleet faults on a `FleetRouter` (see
+    `FleetChaosConfig`).  Returns the installed wrapper — its counters
+    are the test observables; call ``.uninstall()`` to restore the
+    router's real hooks."""
+    return _FleetChaos(router, config)

@@ -21,6 +21,8 @@ from __future__ import annotations
 import json
 import os
 import pathlib
+import subprocess
+import sys
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -95,6 +97,143 @@ class ClusterConfigRegistry:
             raise NotImplementedError("tracker backend lists via tracker")
         return sorted(p.stem for p in
                       pathlib.Path(self.directory).glob("*.json"))
+
+
+def replica_serve_command(model_dir: str, *, host: str = "127.0.0.1",
+                          port: int = 8081, buckets: str = "1,8,32",
+                          max_batch: int = 32, max_wait_ms: float = 2.0,
+                          warmup: bool = True,
+                          max_queue: Optional[int] = None,
+                          deadline_ms: Optional[float] = None,
+                          breaker_threshold: Optional[int] = None,
+                          quantize: Optional[str] = None,
+                          python: Optional[str] = None) -> List[str]:
+    """The command line for ONE process-hosted serving replica: a
+    `dl4j serve` worker on its own port, with graceful SIGTERM drain
+    built in (cli.py), ready to be attached to a `FleetRouter` by URL.
+    Command GENERATION is in-scope and tested; `FleetProcessLauncher`
+    spawns them for real deployments."""
+    cmd = [python or sys.executable, "-m", "deeplearning4j_tpu.cli",
+           "serve", "-model", str(model_dir), "-host", host,
+           "-port", str(int(port)), "-buckets", buckets,
+           "-max-batch", str(int(max_batch)),
+           "-max-wait-ms", str(float(max_wait_ms))]
+    if warmup:
+        cmd.append("-warmup")
+    # `is not None`, not truthiness: the serve parser documents 0 as
+    # "unbounded"/"disabled", so an explicit 0 must be EMITTED (omitting
+    # it would silently reinstate the parser defaults: max-queue 256,
+    # breaker-threshold 5)
+    if max_queue is not None:
+        cmd += ["-max-queue", str(int(max_queue))]
+    if deadline_ms is not None:
+        cmd += ["-deadline-ms", str(float(deadline_ms))]
+    if breaker_threshold is not None:
+        cmd += ["-breaker-threshold", str(int(breaker_threshold))]
+    if quantize:
+        cmd += ["-quantize", quantize]
+    return cmd
+
+
+@dataclass
+class FleetProcessLauncher:
+    """Process-per-replica launching for real serving-fleet deployments
+    (serving/fleet.py): replica i is its own `dl4j serve` process on
+    `base_port + i` — a replica crash is a real process death, and the
+    router's failover/ejection path sees exactly what it would see in
+    production.  Tier-1 tests cover command generation and URL layout;
+    `spawn()` Popens the workers (each takes seconds to warm up, so the
+    CPU test tier hosts replicas in threads instead —
+    `serving.fleet.spawn_local_replica`)."""
+
+    model_dir: str
+    n_replicas: int = 2
+    host: str = "127.0.0.1"
+    base_port: int = 8081
+    buckets: str = "1,8,32"
+    max_batch: int = 32
+    max_wait_ms: float = 2.0
+    warmup: bool = True
+    max_queue: Optional[int] = None
+    deadline_ms: Optional[float] = None
+    breaker_threshold: Optional[int] = None
+    quantize: Optional[str] = None
+
+    def port(self, i: int) -> int:
+        return int(self.base_port) + int(i)
+
+    def url(self, i: int) -> str:
+        return f"http://{self.host}:{self.port(i)}"
+
+    def urls(self) -> List[str]:
+        return [self.url(i) for i in range(int(self.n_replicas))]
+
+    def command(self, i: int) -> List[str]:
+        return replica_serve_command(
+            self.model_dir, host=self.host, port=self.port(i),
+            buckets=self.buckets, max_batch=self.max_batch,
+            max_wait_ms=self.max_wait_ms, warmup=self.warmup,
+            max_queue=self.max_queue, deadline_ms=self.deadline_ms,
+            breaker_threshold=self.breaker_threshold,
+            quantize=self.quantize)
+
+    def spawn(self, i: int) -> "subprocess.Popen":
+        return subprocess.Popen(self.command(i))
+
+    def spawn_all(self) -> List["subprocess.Popen"]:
+        return [self.spawn(i) for i in range(int(self.n_replicas))]
+
+    def wait_ready(self, i: int, timeout_s: float = 60.0,
+                   poll_interval_s: float = 0.5) -> bool:
+        """Poll worker `i`'s `/readyz` until it answers 200 or
+        `timeout_s` elapses.  A `dl4j serve` worker takes seconds to
+        bind and warm its buckets; until then the port connection-refuses
+        and readiness is False."""
+        import http.client
+        import time
+        import urllib.request
+
+        deadline = time.monotonic() + float(timeout_s)
+        url = self.url(i) + "/readyz"
+        while True:
+            try:
+                with urllib.request.urlopen(url, timeout=2.0) as resp:
+                    if resp.status == 200:
+                        return True
+            except (http.client.HTTPException, OSError):
+                pass           # not bound yet / not ready yet: keep polling
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(float(poll_interval_s))
+
+    def attach_all(self, router, ready_timeout_s: float = 60.0) -> list:
+        """Spawn every worker, wait for each `/readyz` to go green, then
+        attach it to a `FleetRouter` by URL.  A fresh `Replica` is
+        routable the moment it is attached (ACTIVE state, closed
+        breaker), so attaching before the worker has bound its port and
+        warmed its buckets would route live traffic into
+        connection-refused — the workers are spawned up front (they warm
+        concurrently) but each joins rotation only once ready.  A worker
+        that never goes green within `ready_timeout_s` raises
+        `TimeoutError` (the spawned processes are left for the caller to
+        reap — `procs` in the raised message)."""
+        from deeplearning4j_tpu.serving.fleet import Replica
+
+        procs = [self.spawn(i) for i in range(int(self.n_replicas))]
+        out = []
+        for i, proc in enumerate(procs):
+            if not self.wait_ready(i, timeout_s=ready_timeout_s):
+                raise TimeoutError(
+                    f"worker-{i} at {self.url(i)} not ready after "
+                    f"{ready_timeout_s}s; {len(procs)} spawned worker "
+                    f"processes left running for the caller to reap")
+            # "worker-{i}", not "replica-{i}": the router's own factory
+            # names replicas "replica-{seq}", and failover exclusion /
+            # pick tie-breaks key on the NAME — a collision would make
+            # one replica's failure exclude an unrelated healthy one
+            out.append(router.attach(
+                Replica(f"worker-{i}", self.url(i), process=proc)))
+        return out
 
 
 @dataclass

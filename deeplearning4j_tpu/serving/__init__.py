@@ -20,12 +20,21 @@ coalescing K concurrent *requests* per device dispatch.
   (`metrics.py`), surfaced via the UI server's `GET /serving/stats`;
 - serving-plane resilience (`resilience.py`, ISSUE-4): typed failures
   (`ServingOverloadError` -> 503 + Retry-After, `DeadlineExceededError`
-  -> 504, `ServingUnavailableError` -> 503, `CircuitOpenError`) and the
-  `CircuitBreaker`; bounded admission, deadline shedding, poison-request
-  bisection and graceful drain are enforced in `batcher.py`/`lm.py`.
+  -> 504, `ServingUnavailableError` -> 503, `CircuitOpenError`,
+  `UnservableShapeError` -> 400) and the `CircuitBreaker`; bounded
+  admission, deadline shedding, poison-request bisection and graceful
+  drain are enforced in `batcher.py`/`lm.py`;
+- the serving fleet (`fleet.py`, ISSUE-6): `FleetRouter` over N replica
+  endpoints — least-loaded + prefix-affinity dispatch, `/readyz`-driven
+  health ejection with half-open re-admission (one `CircuitBreaker` per
+  replica), failover resubmission with an excluded-replica set, rolling
+  weight swaps, queue-depth autoscale through graceful drain — plus the
+  `FleetServer` HTTP front (`/fleet/stats`) and `spawn_local_replica`
+  for thread-hosted replicas (process-per-replica launching lives in
+  `runtime.launcher.FleetProcessLauncher`).
 
 See docs/performance.md (serving cost model), docs/architecture.md and
-docs/robustness.md ("serving plane").
+docs/robustness.md ("serving plane", "serving fleet").
 """
 
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
@@ -35,6 +44,14 @@ from deeplearning4j_tpu.serving.bucketing import (
     pow2_length_buckets,
 )
 from deeplearning4j_tpu.serving.engine import ServingEngine
+from deeplearning4j_tpu.serving.fleet import (
+    FleetClientError,
+    FleetRouter,
+    FleetServer,
+    Replica,
+    check_fleet_ledger,
+    spawn_local_replica,
+)
 from deeplearning4j_tpu.serving.lm import ContinuousLMServer
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.resilience import (
@@ -44,6 +61,7 @@ from deeplearning4j_tpu.serving.resilience import (
     ServingError,
     ServingOverloadError,
     ServingUnavailableError,
+    UnservableShapeError,
 )
 
 __all__ = [
@@ -53,11 +71,18 @@ __all__ = [
     "ContinuousLMServer",
     "DEFAULT_BATCH_BUCKETS",
     "DeadlineExceededError",
+    "FleetClientError",
+    "FleetRouter",
+    "FleetServer",
     "MicroBatcher",
+    "Replica",
     "ServingEngine",
     "ServingError",
     "ServingMetrics",
     "ServingOverloadError",
     "ServingUnavailableError",
+    "UnservableShapeError",
+    "check_fleet_ledger",
     "pow2_length_buckets",
+    "spawn_local_replica",
 ]

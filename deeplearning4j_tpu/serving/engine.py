@@ -24,7 +24,10 @@ import numpy as np
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
 from deeplearning4j_tpu.serving.bucketing import BucketLadder
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
-from deeplearning4j_tpu.serving.resilience import CircuitBreaker
+from deeplearning4j_tpu.serving.resilience import (
+    CircuitBreaker,
+    UnservableShapeError,
+)
 
 
 class ServingEngine:
@@ -119,7 +122,7 @@ class ServingEngine:
             if shape in seen:
                 return
             if len(seen) >= self.max_programs:
-                raise RuntimeError(
+                raise UnservableShapeError(
                     f"compile-count guard: dispatch shape {shape} "
                     f"({dtype}) would exceed the {self.max_programs}-"
                     f"program bound (seen: {sorted(seen)}); the bucket "

@@ -1,0 +1,1069 @@
+"""Serving fleet: replicated engines behind a failover router.
+
+One `ServingEngine` process was both the scale ceiling and the only
+copy — the single point of failure ROADMAP item 5 names.  This module is
+the layer that removes it, modernizing what the 2015 reference's
+`scaleout/` module (ZooKeeper registry + parameter-server workers) was
+for: serving that survives any single worker dying.
+
+- `Replica` — one engine endpoint in the fleet: a URL plus lifecycle
+  hooks.  Thread-hosted replicas carry their in-process `UiServer`
+  (`spawn_local_replica`, how tier-1 CPU tests and the `serve-fleet`
+  CLI host them); process-per-replica deployments attach externally
+  launched `dl4j serve` workers (`runtime.launcher.FleetProcessLauncher`
+  generates/spawns the commands) by URL.
+- `FleetRouter` — dispatch + health + lifecycle:
+
+  * least-loaded dispatch (router-side in-flight per replica) with
+    rendezvous prefix-affinity hashing for LM traffic, so one prompt
+    prefix keeps landing on the same replica (feeds prefix/KV reuse,
+    ROADMAP item 2) without a rebalance storm when membership changes;
+  * health ejection: a background loop (or explicit `poll_health_once`)
+    probes each replica's `/readyz`; failures feed that replica's own
+    `CircuitBreaker` (`serving/resilience.py`) — threshold failures
+    eject it from rotation, the cooldown's half-open window makes the
+    next probe the re-admission test;
+  * failover: predict is pure, so a failed dispatch is *resubmitted* on
+    a different replica with an excluded-replica set — a replica dying
+    mid-storm costs zero failed requests.  Replica 503/504 answers
+    (overload, draining, deadline) fail over WITHOUT a breaker penalty:
+    the replica is alive, just busy; connection-level failures and
+    other 5xx count toward ejection.  4xx answers are the client's
+    request and never retry anywhere;
+  * rolling weight swaps: `rolling_swap()` spawns a standby with the
+    new weights (the factory warms every bucket BEFORE it is attached),
+    attaches it, takes one old replica out of rotation, drains its
+    in-flight work, stops it — repeat per replica.  Zero 5xx under live
+    traffic: the standby is warm before the flip, and a request that
+    raced the flip into the draining replica fails over;
+  * queue-depth-driven autoscale: mean router-side in-flight per active
+    replica above `scale_up_depth` adds a replica, below
+    `scale_down_depth` drains one out gracefully, bounded by
+    `[min_replicas, max_replicas]`.
+
+- `FleetServer` — the fleet's own HTTP front (`/model/predict`,
+  `/lm/generate`, `/fleet/stats`, `/serving/stats`, `/healthz`,
+  `/readyz`) with the same typed-failure -> status mapping as
+  `ui/server.py`, plus fleet-wide graceful drain (the `serve-fleet`
+  SIGTERM path).
+- `check_fleet_ledger` — the cross-layer accounting invariant: every
+  request the fleet answered was answered by exactly one replica, so
+  `sum(replica.requests) == fleet.requests` and client-side
+  `submitted == fleet.requests + fleet.rejected`.
+
+Deterministic fleet chaos (kill-replica, slow-replica, flapping-readyz)
+lives in `resilience/chaos.py` (`FleetChaosConfig` / `chaos_fleet`);
+docs/robustness.md has the eject -> probe -> re-admit lifecycle and the
+rolling-swap timeline.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import subprocess
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent import futures
+from http.server import BaseHTTPRequestHandler
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.resilience import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    DeadlineExceededError,
+    ServingHTTPMixin,
+    ServingHTTPServer,
+    ServingUnavailableError,
+)
+
+
+class FleetClientError(ValueError):
+    """A replica answered 4xx: the request payload itself is wrong, so
+    retrying it on a different replica would just fail again — the
+    router propagates it instead of failing over.  Maps back to the
+    replica's status code at the fleet front."""
+
+    def __init__(self, msg: str, status: int = 400):
+        super().__init__(msg)
+        self.status = int(status)
+
+
+class _ReplicaDispatchError(RuntimeError):
+    """Internal: one dispatch attempt against one replica failed in a
+    way that justifies failover.  `replica_fault` distinguishes a
+    replica that is *broken* (connection refused/reset, 500 — counts
+    toward breaker ejection) from one that is alive but unavailable
+    (503 overload/draining, 504 deadline — fail over penalty-free)."""
+
+    def __init__(self, msg: str, replica_fault: bool):
+        super().__init__(msg)
+        self.replica_fault = bool(replica_fault)
+
+
+# Replica lifecycle states (the closed vocabulary /fleet/stats uses):
+REPLICA_ACTIVE = "active"
+REPLICA_DRAINING = "draining"
+REPLICA_STOPPED = "stopped"
+
+
+class Replica:
+    """One serving endpoint in the fleet.
+
+    `server` is the in-process `UiServer` for thread-hosted replicas
+    (tests, `serve-fleet` CLI); `process` a `subprocess.Popen` for
+    process-per-replica deployments; both may be None for a purely
+    attached URL (an externally managed worker).  The router assigns
+    `breaker` at attach time when none is supplied, and owns the
+    router-side counters (`in_flight`, `dispatches`, `failures`).
+    """
+
+    def __init__(self, name: str, url: str, server=None, process=None,
+                 breaker: Optional[CircuitBreaker] = None, version: int = 0):
+        self.name = str(name)
+        self.url = url.rstrip("/")
+        self.server = server
+        self.process = process
+        self.breaker = breaker
+        self.version = int(version)
+        self.lock = threading.Lock()
+        self.state = REPLICA_ACTIVE
+        self.in_flight = 0      # router-side queue-depth proxy
+        self.dispatches = 0     # successful dispatches via the router
+        self.failures = 0       # replica-fault dispatch failures
+        self.ejections = 0      # breaker closed/half-open -> open
+        self.readmissions = 0   # open/half-open -> closed
+        self._ejected = False
+
+    def _on_breaker(self, state: str) -> None:
+        # NOTE: fired while the breaker holds ITS lock; `self.lock` is
+        # only ever taken after a breaker lock (never the reverse), so
+        # the ordering is acyclic.
+        with self.lock:
+            if state == BREAKER_OPEN:
+                self.ejections += 1
+                self._ejected = True
+            elif state == "closed" and self._ejected:
+                self.readmissions += 1
+                self._ejected = False
+
+    def routable(self) -> bool:
+        """Eligible for new traffic: in rotation and not breaker-open.
+        `breaker.state` lazily commits open -> half_open once the
+        cooldown elapses, so an ejected replica re-enters routing
+        exactly when its re-admission probe window opens."""
+        if self.state != REPLICA_ACTIVE:
+            return False
+        return self.breaker is None or self.breaker.state != BREAKER_OPEN
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def begin_drain(self) -> None:
+        if self.server is not None:
+            self.server.begin_drain()
+
+    def drain(self, grace_s: float = 5.0) -> bool:
+        """Graceful: stop admission, let in-flight work finish.  For a
+        process replica this is SIGTERM — `dl4j serve` installs the
+        graceful-drain handler (cli.py)."""
+        if self.server is not None:
+            return self.server.drain(grace_s)
+        if self.process is not None:
+            self.process.terminate()
+            try:
+                self.process.wait(timeout=grace_s)
+                return True
+            except subprocess.TimeoutExpired:
+                return False
+        return True
+
+    def stop(self) -> None:
+        self.state = REPLICA_STOPPED
+        if self.server is not None:
+            self.server.stop()
+        if self.process is not None:
+            self.process.terminate()
+
+    def kill(self) -> None:
+        """Hard stop — the chaos 'replica process died' fault.  For a
+        thread-hosted replica the HTTP socket closes and its engine
+        fails queued work typed; in-flight router dispatches see a
+        connection error or a 503 and fail over either way.
+        Deliberately does NOT flip `state`: the control plane has not
+        noticed the death yet — the router must discover it the honest
+        way (dispatch failures and failed readyz probes feeding the
+        breaker until ejection)."""
+        if self.process is not None:
+            self.process.kill()
+        elif self.server is not None:
+            self.server.stop()
+
+    def summary(self) -> Dict:
+        with self.lock:
+            out = {"name": self.name, "url": self.url, "state": self.state,
+                   "version": self.version, "in_flight": self.in_flight,
+                   "dispatches": self.dispatches, "failures": self.failures,
+                   "ejections": self.ejections,
+                   "readmissions": self.readmissions}
+        out["breaker"] = self.breaker.state if self.breaker else None
+        return out
+
+
+def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
+                        host: str = "127.0.0.1", ladder=None,
+                        max_batch: Optional[int] = None,
+                        max_wait_ms: float = 2.0, warmup_example=None,
+                        max_queue_depth: Optional[int] = None,
+                        default_deadline_s: Optional[float] = None,
+                        breaker_threshold: Optional[int] = 5,
+                        breaker_cooldown_s: float = 1.0,
+                        quantize: Optional[str] = None,
+                        version: int = 0) -> Replica:
+    """Thread-hosted replica: an in-process `UiServer` on a free port
+    with its own engine surface (`/model/predict`, `/lm/generate`,
+    `/serving/stats`, `/readyz`).  `warmup_example` pre-compiles every
+    bucket shape BEFORE the replica is returned — a rolling swap attaches
+    only warm standbys, which is what makes the flip zero-5xx.  `lm` is
+    an optional `(cfg, params)` pair for the continuous LM pool."""
+    from deeplearning4j_tpu.ui.server import UiServer
+
+    srv = UiServer(host=host, port=0)
+    if net is not None:
+        from deeplearning4j_tpu.serving.bucketing import BucketLadder
+
+        ladder = ladder if ladder is not None else BucketLadder()
+        srv.serve_model(
+            net, ladder=ladder,
+            max_batch=(max_batch if max_batch is not None
+                       else ladder.max_batch),
+            max_wait_ms=max_wait_ms, warmup_example=warmup_example,
+            max_queue_depth=max_queue_depth,
+            default_deadline_s=default_deadline_s,
+            breaker_threshold=breaker_threshold,
+            breaker_cooldown_s=breaker_cooldown_s, quantize=quantize)
+    if lm is not None:
+        cfg, params = lm
+        srv.serve_lm(cfg, params, slots=lm_slots,
+                     max_queue_depth=max_queue_depth,
+                     default_deadline_s=default_deadline_s,
+                     breaker_threshold=breaker_threshold,
+                     breaker_cooldown_s=breaker_cooldown_s)
+    srv.start()
+    return Replica(name, srv.url, server=srv, version=version)
+
+
+class FleetRouter:
+    """Failover router over N replica endpoints.
+
+    `factory(name) -> Replica` spawns a warm replica (see
+    `spawn_local_replica`); `replicas` spawns that many up front.
+    Externally launched workers attach by URL via `attach()`.  All
+    dispatch is HTTP to the replica's endpoint surface, so thread-hosted
+    and process-hosted replicas fail (and fail over) identically.
+    """
+
+    def __init__(self, factory: Optional[Callable[[str], Replica]] = None,
+                 replicas: int = 0, *,
+                 replica_breaker_threshold: int = 2,
+                 replica_breaker_cooldown_s: float = 1.0,
+                 health_interval_s: float = 1.0,
+                 request_timeout_s: float = 60.0,
+                 probe_timeout_s: float = 2.0,
+                 affinity_prefix_tokens: int = 8,
+                 affinity_spill_depth: int = 8,
+                 min_replicas: int = 1, max_replicas: int = 8,
+                 scale_up_depth: float = 4.0,
+                 scale_down_depth: float = 0.5,
+                 metrics: Optional[ServingMetrics] = None):
+        self.factory = factory
+        self.replica_breaker_threshold = int(replica_breaker_threshold)
+        self.replica_breaker_cooldown_s = float(replica_breaker_cooldown_s)
+        self.health_interval_s = float(health_interval_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.affinity_prefix_tokens = int(affinity_prefix_tokens)
+        self.affinity_spill_depth = int(affinity_spill_depth)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.scale_up_depth = float(scale_up_depth)
+        self.scale_down_depth = float(scale_down_depth)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._lock = threading.Lock()
+        self._replicas: List[Replica] = []
+        self._seq = 0
+        self._version = 0
+        self.failovers = 0       # failed dispatch attempts that moved on
+        self.swaps = 0           # completed rolling swaps
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.health_polls = 0
+        self.autoscale = False   # health loop calls autoscale_tick() too
+        self._autoscale_busy = threading.Lock()
+        # ledger counts of gracefully retired replicas (rolling swap /
+        # scale-down) + how many retired without reporting (process
+        # SIGTERM, corpse) — check_fleet_ledger folds these in
+        self._retired_agg = {"requests": 0, "rejected": 0, "shed": 0,
+                             "deadline_missed": 0, "poison_isolated": 0}
+        self._retired_lost = 0
+        self._stop_health = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        for _ in range(int(replicas)):
+            self.add_replica()
+
+    # ---- membership -------------------------------------------------------
+
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._replicas)
+
+    def attach(self, replica: Replica) -> Replica:
+        """Put a replica into rotation.  Assigns the router's breaker
+        policy when the replica has none; every breaker transition feeds
+        the replica's ejection/re-admission counters."""
+        if replica.breaker is None:
+            replica.breaker = CircuitBreaker(
+                failure_threshold=self.replica_breaker_threshold,
+                cooldown_s=self.replica_breaker_cooldown_s)
+        replica.breaker.add_listener(replica._on_breaker)
+        with self._lock:
+            self._replicas.append(replica)
+        return replica
+
+    def add_replica(self) -> Replica:
+        """Spawn (via the factory) and attach one replica."""
+        if self.factory is None:
+            raise ValueError("no replica factory configured")
+        with self._lock:
+            name = f"replica-{self._seq}"
+            self._seq += 1
+            version = self._version
+        replica = self.factory(name)
+        replica.version = version
+        return self.attach(replica)
+
+    def remove(self, replica: Replica, grace_s: float = 5.0) -> bool:
+        """Take a replica out of rotation, drain it gracefully, stop
+        it.  Returns True when its in-flight work finished in time.
+        The replica's final serving counts are folded into the router's
+        retired aggregate first, so the fleet ledger keeps balancing
+        after rolling swaps and scale-downs instead of permanently
+        reporting the retired replicas' requests as lost."""
+        with self._lock:
+            replica.state = REPLICA_DRAINING
+        drained = replica.drain(grace_s)
+        payload = self._replica_stats(replica)
+        with self._lock:
+            # fold ONLY when this call actually takes the replica out of
+            # the list: concurrent remove()s of the same replica (e.g. a
+            # rolling swap racing an async autoscale scale-down) must
+            # count its requests exactly once
+            removed = replica in self._replicas
+            if removed:
+                self._replicas.remove(replica)
+                if payload is None:
+                    # a process replica's SIGTERM drain already stopped
+                    # its HTTP surface (and a corpse never answers): its
+                    # counts are unrecoverable — the ledger reports that
+                    # honestly
+                    self._retired_lost += 1
+                else:
+                    _fold_plane_counts(self._retired_agg, payload)
+        replica.stop()
+        return drained
+
+    def has_routable(self) -> bool:
+        with self._lock:
+            return any(r.routable() for r in self._replicas)
+
+    # ---- picking ----------------------------------------------------------
+
+    @staticmethod
+    def _rendezvous_weight(key: str, name: str) -> bytes:
+        return hashlib.blake2b(f"{key}|{name}".encode(),
+                               digest_size=8).digest()
+
+    def _pick(self, excluded: frozenset = frozenset(),
+              key: Optional[str] = None) -> Optional[Replica]:
+        """Choose a replica for one dispatch attempt.  Least-loaded by
+        router-side in-flight (ties broken deterministically by name);
+        with an affinity `key`, rendezvous hashing picks a preferred
+        replica that stays stable under membership changes, spilling to
+        least-loaded only when the preferred one is backed up by more
+        than `affinity_spill_depth` requests over the least loaded."""
+        with self._lock:
+            candidates = [r for r in self._replicas
+                          if r.routable() and r.name not in excluded]
+        if not candidates:
+            return None
+        # a half-open replica is ejected-pending-probe, not healthy: its
+        # in_flight is ~0 precisely BECAUSE it got no traffic, so plain
+        # least-loaded would prefer the corpse for every new request.
+        # Route to closed-breaker replicas whenever any exist; half-open
+        # ones are the last resort (and `_dispatch`'s allow_dispatch
+        # gate caps them to one probe at a time)
+        healthy = [r for r in candidates
+                   if r.breaker is None
+                   or r.breaker.state == BREAKER_CLOSED]
+        pool = healthy or candidates
+        least = min(pool, key=lambda r: (r.in_flight, r.name))
+        if key is None:
+            return least
+        preferred = max(pool,
+                        key=lambda r: self._rendezvous_weight(key, r.name))
+        if preferred.in_flight - least.in_flight > self.affinity_spill_depth:
+            return least
+        return preferred
+
+    # ---- transport --------------------------------------------------------
+
+    def _http(self, method: str, url: str, body=None,
+              timeout: Optional[float] = None):
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(
+                req, timeout=(timeout if timeout is not None
+                              else self.request_timeout_s)) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+
+    def _dispatch(self, replica: Replica, path: str, body,
+                  timeout: Optional[float] = None):
+        """One dispatch attempt against one replica.  Raises
+        `FleetClientError` (4xx — never retried) or
+        `_ReplicaDispatchError` (failover) on failure; feeds the
+        replica's breaker and router-side counters."""
+        if (replica.breaker is not None
+                and not replica.breaker.allow_dispatch()):
+            # half-open single-probe discipline (same as batcher/lm):
+            # one request at a time rides the re-admission probe; the
+            # rest fail over penalty-free instead of piling unbounded
+            # traffic — each hanging up to request_timeout_s — onto a
+            # replica the breaker has not re-admitted yet
+            raise _ReplicaDispatchError(
+                f"replica {replica.name} half-open: re-admission probe "
+                f"already in flight", replica_fault=False)
+        with replica.lock:
+            replica.in_flight += 1
+        try:
+            try:
+                _, payload = self._http("POST", replica.url + path, body,
+                                        timeout)
+            except urllib.error.HTTPError as e:
+                status = e.code
+                try:
+                    detail = json.loads(e.read() or b"{}").get("error", "")
+                except ValueError:
+                    detail = ""
+                if 400 <= status < 500:
+                    raise FleetClientError(
+                        detail or f"replica {replica.name} answered "
+                                  f"{status}", status=status) from e
+                # 503/504: alive but unavailable (overload / draining /
+                # deadline) — fail over penalty-free.  Any other 5xx is
+                # a replica fault and counts toward ejection.
+                raise _ReplicaDispatchError(
+                    f"replica {replica.name} answered {status}: {detail}",
+                    replica_fault=status not in (503, 504)) from e
+            except (http.client.HTTPException, OSError, ValueError) as e:
+                # connection refused/reset, short read, timeout, or a
+                # 2xx answer whose body is not JSON (a misconfigured
+                # attached endpoint): the replica is gone, wedged, or
+                # answering garbage — a breaker-worthy fault either way
+                raise _ReplicaDispatchError(
+                    f"replica {replica.name} unusable: "
+                    f"{type(e).__name__}: {e}", replica_fault=True) from e
+        except FleetClientError:
+            # the replica ANSWERED — the payload was the problem.  An
+            # answer is liveness evidence: it re-admits a half-open
+            # replica (releasing the probe claim) and resets the
+            # failure streak, exactly like a 200 would
+            if replica.breaker is not None:
+                replica.breaker.record_success()
+            raise
+        except _ReplicaDispatchError as e:
+            if replica.breaker is not None:
+                if e.replica_fault:
+                    replica.breaker.record_failure()
+                else:
+                    # 503/504: alive-but-unavailable is neither
+                    # re-admission evidence nor a fault — just release
+                    # any probe claim so the half-open window stays open
+                    replica.breaker.abandon_probe()
+            with replica.lock:
+                if e.replica_fault:
+                    replica.failures += 1
+            raise
+        finally:
+            with replica.lock:
+                replica.in_flight -= 1
+        if replica.breaker is not None:
+            replica.breaker.record_success()
+        with replica.lock:
+            replica.dispatches += 1
+        return payload
+
+    def _submit(self, path: str, body, key: Optional[str] = None,
+                timeout: Optional[float] = None):
+        """Failover loop: try routable replicas (excluded set grows per
+        failure) until one answers or none remain.  Predict is pure, so
+        resubmitting a failed dispatch elsewhere is always safe."""
+        t0 = time.perf_counter()
+        # the client's deadline is a TOTAL budget across failovers: each
+        # retry forwards only what remains of it, and an exhausted
+        # budget is a typed 504 here — not a fresh full-deadline
+        # dispatch per attempt
+        deadline_ms = (body.get("deadline_ms")
+                       if isinstance(body, dict) else None)
+        excluded: set = set()
+        last: Optional[BaseException] = None
+        while True:
+            if deadline_ms is not None:
+                remaining = deadline_ms - (time.perf_counter() - t0) * 1e3
+                if remaining <= 0:
+                    self.metrics.record_deadline_missed()
+                    self.metrics.record_rejected()
+                    raise DeadlineExceededError(
+                        f"deadline of {deadline_ms:.0f}ms exhausted "
+                        f"after {len(excluded)} failover(s)"
+                        + (f" (last failure: {last})" if last else ""))
+                body["deadline_ms"] = remaining
+            replica = self._pick(frozenset(excluded), key)
+            if replica is None:
+                break
+            try:
+                payload = self._dispatch(replica, path, body, timeout)
+            except FleetClientError:
+                # the payload's fault everywhere — no failover, but it
+                # is still a typed rejection in the router's ledger:
+                # client_balanced (submitted == requests + rejected)
+                # must keep holding when some submissions are 4xx
+                self.metrics.record_rejected()
+                raise
+            except _ReplicaDispatchError as e:
+                excluded.add(replica.name)
+                with self._lock:
+                    self.failovers += 1
+                last = e
+                continue
+            self.metrics.record_request(time.perf_counter() - t0)
+            return payload
+        self.metrics.record_rejected()
+        raise ServingUnavailableError(
+            "no routable replica" + (f" (last failure: {last})"
+                                     if last else ""))
+
+    # ---- client surface ---------------------------------------------------
+
+    def predict_proba(self, x, deadline_s: Optional[float] = None,
+                      timeout: Optional[float] = None) -> np.ndarray:
+        """[n, ...] features -> [n, classes] activations, served by
+        whichever healthy replica the router picks (float32 survives the
+        JSON hop bit-exactly: float32 -> float64 -> shortest-repr
+        round-trip -> float32 is the identity)."""
+        body: Dict = {"features": np.asarray(x, np.float32).tolist()}
+        if deadline_s is not None:
+            body["deadline_ms"] = float(deadline_s) * 1e3
+        payload = self._submit("/model/predict", body, timeout=timeout)
+        return np.asarray(payload["outputs"], np.float32)
+
+    def predict(self, x, deadline_s: Optional[float] = None,
+                timeout: Optional[float] = None) -> np.ndarray:
+        return np.argmax(self.predict_proba(x, deadline_s=deadline_s,
+                                            timeout=timeout), axis=-1)
+
+    def generate_payload(self, prompt_ids: Sequence[int],
+                         max_new_tokens: int, temperature: float = 0.0,
+                         seed: int = 0, top_k: int = 0, top_p: float = 1.0,
+                         beam_size: int = 0,
+                         deadline_s: Optional[float] = None,
+                         timeout: Optional[float] = None) -> Dict:
+        """LM generation with prefix-affinity routing: the first
+        `affinity_prefix_tokens` prompt tokens pick the preferred
+        replica via rendezvous hashing, so a shared system prompt keeps
+        hitting the same replica's (future) prefix cache.  Returns the
+        replica's full JSON answer (`ids`, plus `score` on the beam
+        path).  top-k / top-p / beam forward to the replica's
+        whole-sequence leg (ui/server.py routes them off the continuous
+        pool); every mode is seeded and deterministic, so failover
+        resubmission stays safe for all of them."""
+        ids = [int(t) for t in prompt_ids]
+        key = ",".join(map(str, ids[:self.affinity_prefix_tokens]))
+        body: Dict = {"prompt_ids": ids,
+                      "max_new_tokens": int(max_new_tokens),
+                      "temperature": float(temperature), "seed": int(seed)}
+        if int(top_k):
+            body["top_k"] = int(top_k)
+        if float(top_p) < 1.0:
+            body["top_p"] = float(top_p)
+        if int(beam_size) > 1:
+            body["beam_size"] = int(beam_size)
+        if deadline_s is not None:
+            body["deadline_ms"] = float(deadline_s) * 1e3
+        return self._submit("/lm/generate", body, key=key, timeout=timeout)
+
+    def generate(self, prompt_ids: Sequence[int], max_new_tokens: int,
+                 temperature: float = 0.0, seed: int = 0,
+                 top_k: int = 0, top_p: float = 1.0, beam_size: int = 0,
+                 deadline_s: Optional[float] = None,
+                 timeout: Optional[float] = None) -> List[int]:
+        payload = self.generate_payload(
+            prompt_ids, max_new_tokens, temperature=temperature, seed=seed,
+            top_k=top_k, top_p=top_p, beam_size=beam_size,
+            deadline_s=deadline_s, timeout=timeout)
+        return list(payload["ids"])
+
+    # ---- health: eject -> probe -> re-admit -------------------------------
+
+    def _probe_readyz(self, replica: Replica) -> bool:
+        try:
+            status, _ = self._http("GET", replica.url + "/readyz",
+                                   timeout=self.probe_timeout_s)
+            return status == 200
+        except (http.client.HTTPException, OSError, ValueError):
+            # HTTPError (e.g. a 503 from a draining/broken replica) is
+            # an OSError subclass; ValueError covers a 200 whose body is
+            # not JSON.  Any failure mode means not ready — and nothing
+            # may escape here, or it would kill the health daemon
+            return False
+
+    def poll_health_once(self,
+                         _async_autoscale: bool = False) -> Dict[str, bool]:
+        """One health sweep: probe every in-rotation replica's /readyz.
+        A failed probe is a breaker failure (threshold consecutive
+        failures eject); a successful probe on a half-open breaker IS
+        the re-admission.  Ejected replicas inside their cooldown are
+        skipped — the cooldown elapsing re-opens the probe window.
+
+        A green probe on a CLOSED breaker records nothing: /readyz
+        succeeding must not erase dispatch-failure evidence, or a
+        replica that 500s every dispatch while its readyz stays green
+        would never accumulate the threshold consecutive failures and
+        never be ejected.  Successful dispatches already reset the
+        streak; the probe only votes to re-admit."""
+        with self._lock:
+            self.health_polls += 1
+            replicas = [r for r in self._replicas
+                        if r.state == REPLICA_ACTIVE]
+        results: Dict[str, bool] = {}
+        # probe concurrently: one wedged replica must cost the sweep one
+        # probe_timeout_s, not serialize behind every other probe and
+        # degrade the whole fleet's detection cadence
+        probe = [r for r in replicas
+                 if not (r.breaker is not None and r.breaker.rejecting())]
+        if probe:                          # skipped: cooldown not elapsed
+            with futures.ThreadPoolExecutor(
+                    max_workers=min(8, len(probe))) as pool:
+                outcomes = list(pool.map(self._probe_readyz, probe))
+            for r, ok in zip(probe, outcomes):
+                results[r.name] = ok
+                if r.breaker is not None:
+                    if ok:
+                        if r.breaker.state == BREAKER_HALF_OPEN:
+                            r.breaker.record_success()
+                    else:
+                        r.breaker.record_failure()
+        if self.autoscale:
+            if _async_autoscale:
+                self._spawn_autoscale_tick()
+            else:
+                self.autoscale_tick()
+        return results
+
+    def _spawn_autoscale_tick(self) -> None:
+        """Run one autoscale decision OFF the health thread: a
+        scale-down drains (seconds of grace) and a scale-up warms every
+        bucket (seconds of compilation) — neither may stall /readyz
+        probing, or a replica dying during the action would go
+        undetected for the whole window.  At most one action runs at a
+        time; ticks arriving while one is in flight are dropped (the
+        next poll re-evaluates from fresh queue depths)."""
+        if not self._autoscale_busy.acquire(blocking=False):
+            return
+
+        def run():
+            try:
+                self.autoscale_tick()
+            finally:
+                self._autoscale_busy.release()
+
+        threading.Thread(target=run, daemon=True,
+                         name="fleet-autoscale").start()
+
+    def start_health_loop(self,
+                          interval_s: Optional[float] = None) -> None:
+        if interval_s is not None:
+            self.health_interval_s = float(interval_s)
+        if self._health_thread is not None:
+            return
+        self._stop_health.clear()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="fleet-health")
+        self._health_thread.start()
+
+    def _health_loop(self) -> None:
+        # the loop dispatches autoscale actions to a side thread so a
+        # drain or a standby warmup can never stall /readyz probing;
+        # explicit poll_health_once() callers keep the synchronous tick
+        while not self._stop_health.wait(self.health_interval_s):
+            self.poll_health_once(_async_autoscale=True)
+
+    def stop_health_loop(self) -> None:
+        self._stop_health.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5)
+            self._health_thread = None
+
+    # ---- rolling weight swap ----------------------------------------------
+
+    def rolling_swap(self, factory: Optional[Callable[[str], Replica]]
+                     = None, grace_s: float = 10.0) -> List[Dict]:
+        """Zero-downtime weight swap.  Per active replica, in order:
+        spawn a standby with the new weights (the factory warms every
+        bucket before returning, so the standby never compiles on the
+        request path), attach it, take the old replica out of rotation,
+        drain its in-flight work, stop it.  Traffic keeps flowing the
+        whole time — at least N replicas are routable at every instant,
+        and a request that raced into the draining replica fails over.
+        `factory` (when given) becomes the fleet's replica factory, so
+        scale-ups after the swap also serve the new weights."""
+        if factory is not None:
+            self.factory = factory
+        if self.factory is None:
+            raise ValueError("rolling_swap needs a replica factory")
+        with self._lock:
+            self._version += 1
+            olds = [r for r in self._replicas
+                    if r.state == REPLICA_ACTIVE]
+        steps = []
+        for old in olds:
+            standby = self.add_replica()
+            drained = self.remove(old, grace_s)
+            steps.append({"retired": old.name, "standby": standby.name,
+                          "drained": drained})
+        with self._lock:
+            self.swaps += 1
+        return steps
+
+    # ---- queue-depth-driven scaling ---------------------------------------
+
+    def autoscale_tick(self, grace_s: float = 5.0) -> int:
+        """One scaling decision from the router-side queue-depth proxy
+        (mean in-flight per active replica).  Returns +1 (scaled up),
+        -1 (scaled down through graceful drain) or 0."""
+        with self._lock:
+            active = [r for r in self._replicas
+                      if r.state == REPLICA_ACTIVE]
+            if not active:
+                return 0
+            load = sum(r.in_flight for r in active) / len(active)
+        if (load > self.scale_up_depth and len(active) < self.max_replicas
+                and self.factory is not None):
+            self.add_replica()
+            with self._lock:
+                self.scale_ups += 1
+            return 1
+        if load < self.scale_down_depth and len(active) > self.min_replicas:
+            victim = min(active, key=lambda r: (r.in_flight, r.name))
+            self.remove(victim, grace_s)
+            with self._lock:
+                self.scale_downs += 1
+            return -1
+        return 0
+
+    # ---- stats / lifecycle ------------------------------------------------
+
+    def _replica_stats(self, replica: Replica) -> Optional[Dict]:
+        try:
+            _, payload = self._http("GET", replica.url + "/serving/stats",
+                                    timeout=self.probe_timeout_s)
+            return payload
+        except (http.client.HTTPException, OSError, ValueError):
+            return None
+
+    def fleet_stats(self, include_replica_stats: bool = True) -> Dict:
+        """The /fleet/stats payload: fleet-level metrics + per-replica
+        breakdown (each replica's own /serving/stats inlined), plus the
+        aggregated resilience ledger (`check_fleet_ledger`)."""
+        with self._lock:
+            counters = {"failovers": self.failovers, "swaps": self.swaps,
+                        "scale_ups": self.scale_ups,
+                        "scale_downs": self.scale_downs,
+                        "health_polls": self.health_polls,
+                        "weights_version": self._version}
+            replicas = list(self._replicas)
+            retired = {"aggregate": dict(self._retired_agg),
+                       "lost": self._retired_lost}
+        # fan the per-replica /serving/stats fetches out concurrently:
+        # sequentially, one slow replica holds up the whole payload for
+        # its probe timeout, and N replicas cost N timeouts end-to-end
+        fetch = [r for r in replicas
+                 if include_replica_stats and r.state != REPLICA_STOPPED]
+        stats_by_name: Dict[str, Optional[Dict]] = {}
+        if fetch:
+            with futures.ThreadPoolExecutor(
+                    max_workers=min(8, len(fetch))) as pool:
+                for r, payload in zip(
+                        fetch, pool.map(self._replica_stats, fetch)):
+                    stats_by_name[r.name] = payload
+        entries = []
+        for r in replicas:
+            entry = r.summary()
+            if r.name in stats_by_name:
+                entry["stats"] = stats_by_name[r.name]
+            entries.append(entry)
+        fleet = dict(self.metrics.snapshot())
+        fleet["replicas_active"] = sum(
+            1 for r in replicas if r.state == REPLICA_ACTIVE)
+        fleet["replicas_routable"] = sum(
+            1 for r in replicas if r.routable())
+        fleet.update(counters)
+        out = {"fleet": fleet, "replicas": entries, "retired": retired}
+        if include_replica_stats:
+            out["ledger"] = check_fleet_ledger(out)
+        return out
+
+    def begin_drain(self) -> None:
+        for r in self.replicas():
+            with self._lock:
+                r.state = REPLICA_DRAINING
+            r.begin_drain()
+
+    def drain(self, grace_s: float = 5.0) -> bool:
+        """Fleet-wide graceful drain: every replica stops admission,
+        in-flight work gets the (shared) grace window."""
+        self.begin_drain()
+        deadline = time.perf_counter() + max(0.0, grace_s)
+        drained = True
+        for r in self.replicas():
+            drained &= r.drain(max(0.0, deadline - time.perf_counter()))
+        return drained
+
+    def stop(self) -> None:
+        self.stop_health_loop()
+        for r in self.replicas():
+            r.stop()
+        with self._lock:
+            self._replicas.clear()
+
+
+def _fold_plane_counts(agg: Dict, payload: Dict) -> None:
+    """Add one replica's /serving/stats ledger counts (both planes)
+    into the running aggregate."""
+    for plane in ("classifier", "lm"):
+        section = payload.get(plane)
+        if not section:
+            continue
+        for k in agg:
+            agg[k] += int(section.get(k) or 0)
+
+
+def check_fleet_ledger(stats: Dict,
+                       submitted: Optional[int] = None) -> Dict:
+    """Aggregate the per-replica resilience ledgers out of a
+    `fleet_stats()` payload and check the cross-layer invariants:
+
+    - every request the fleet answered was answered by exactly ONE
+      replica, so `sum(replica requests) == fleet requests` — counting
+      replicas the router retired gracefully (rolling swap, scale-down:
+      their final counts live in the payload's `retired` aggregate, so
+      the invariant keeps holding across membership changes, not just
+      for the replicas currently attached);
+    - client-side (when `submitted` is passed):
+      `submitted == fleet requests + fleet rejected` — a request either
+      got an answer or a typed rejection, never silence.
+
+    Replica-side `rejected`/`shed` above the fleet's own counts are the
+    failovers: a replica refused or shed work that another replica then
+    served.  `balanced` is only asserted when every replica's stats
+    were reachable (a killed replica cannot report, and a retired
+    process replica's counts die with its SIGTERM — `retired.lost`)."""
+    agg = {"requests": 0, "rejected": 0, "shed": 0, "deadline_missed": 0,
+           "poison_isolated": 0}
+    retired = stats.get("retired") or {}
+    for k, v in (retired.get("aggregate") or {}).items():
+        if k in agg:
+            agg[k] += int(v or 0)
+    reachable = int(retired.get("lost") or 0) == 0
+    for entry in stats.get("replicas", ()):
+        payload = entry.get("stats")
+        if payload is None:
+            if entry.get("state") != REPLICA_STOPPED:
+                reachable = False
+            continue
+        _fold_plane_counts(agg, payload)
+    fleet = stats.get("fleet", {})
+    out = {"aggregate": agg, "replicas_reachable": reachable,
+           "fleet_requests": int(fleet.get("requests") or 0),
+           "fleet_rejected": int(fleet.get("rejected") or 0)}
+    out["balanced"] = (reachable
+                       and agg["requests"] == out["fleet_requests"])
+    if submitted is not None:
+        out["submitted"] = int(submitted)
+        out["client_balanced"] = (
+            int(submitted) == out["fleet_requests"] + out["fleet_rejected"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The fleet's own HTTP front
+
+
+class _FleetHTTPServer(ServingHTTPServer):
+    # restart-after-drain socket semantics (SO_REUSEADDR + daemon
+    # handler threads) live on the shared base in serving/resilience.py
+    pass
+
+
+class _FleetHandler(ServingHTTPMixin, BaseHTTPRequestHandler):
+    # _send/_json/_body/_deadline_s + the typed-failure -> status
+    # mapping come from ServingHTTPMixin (serving/resilience.py), shared
+    # with ui/server.py's _Handler so the two HTTP contracts cannot
+    # drift.
+
+    @property
+    def router(self) -> FleetRouter:
+        return self.server.fleet_router  # type: ignore[attr-defined]
+
+    def do_GET(self) -> None:  # noqa: N802
+        if self.path == "/healthz":
+            self._json(200, {"ok": True})
+        elif self.path == "/readyz":
+            draining = self.server.fleet_draining  # type: ignore[attr-defined]
+            if draining:
+                self._json(503, {"ready": False, "reasons": ["draining"]},
+                           headers={"Retry-After": 1})
+            elif not self.router.has_routable():
+                self._json(503, {"ready": False,
+                                 "reasons": ["no routable replica"]},
+                           headers={"Retry-After": 1})
+            else:
+                self._json(200, {"ready": True})
+        elif self.path == "/fleet/stats":
+            self._json(200, self.router.fleet_stats())
+        elif self.path == "/serving/stats":
+            # the cheap fleet-level view (no per-replica HTTP fan-out)
+            self._json(200, self.router.fleet_stats(
+                include_replica_stats=False))
+        else:
+            self._json(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        try:
+            body = self._body()
+        except (ValueError, json.JSONDecodeError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        try:
+            if self.server.fleet_draining:  # type: ignore[attr-defined]
+                raise ServingUnavailableError(
+                    "fleet is draining: admission stopped")
+            self._route_post(body)
+        except FleetClientError as e:
+            self._json(e.status, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — the front must keep serving; unexpected -> 500 once, typed stay 4xx/503
+            # typed serving failures map via the shared mixin
+            # (UnservableShapeError -> 400, DeadlineExceededError -> 504,
+            # overload/unavailable -> 503 + Retry-After); a malformed
+            # request (bad deadline, wrong field types) is the client's
+            # 400; anything else is the fleet front's own fault: 500
+            if self.respond_typed_failure(e):
+                return
+            if isinstance(e, (ValueError, TypeError)):
+                self._json(400, {"error": str(e)})
+            else:
+                self._json(500, {"error": repr(e)})
+
+    def _route_post(self, body) -> None:
+        if self.path == "/model/predict":
+            feats = body.get("features")
+            if not feats:
+                self._json(400, {"error": "features required"})
+                return
+            probs = self.router.predict_proba(
+                feats, deadline_s=self._deadline_s(body))
+            self._json(200, {
+                "predictions": np.argmax(probs, axis=-1).tolist(),
+                "outputs": np.asarray(probs).tolist()})
+        elif self.path == "/lm/generate":
+            prompt = body.get("prompt_ids")
+            if not prompt:
+                self._json(400, {"error": "prompt_ids required"})
+                return
+            # forward the sampling mode too: silently downgrading a
+            # top-k/top-p/beam request to greedy would answer 200 with
+            # DIFFERENT generations than the single-server surface
+            payload = self.router.generate_payload(
+                prompt, int(body.get("max_new_tokens", 32)),
+                temperature=float(body.get("temperature", 0.0)),
+                seed=int(body.get("seed", 0)) & 0x7FFFFFFF,
+                top_k=int(body.get("top_k", 0)),
+                top_p=float(body.get("top_p", 1.0)),
+                beam_size=int(body.get("beam_size", 0)),
+                deadline_s=self._deadline_s(body))
+            self._json(200, payload)
+        else:
+            self._json(404, {"error": f"unknown path {self.path}"})
+
+
+class FleetServer:
+    """The fleet's HTTP front: `FleetServer(router, port=0).start()`;
+    `.url` for clients; `.drain()` for the SIGTERM path; `.stop()` to
+    halt (stops the router, its health loop and every replica)."""
+
+    def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
+                 port: int = 8080):
+        self.router = router
+        self._server = _FleetHTTPServer((host, port), _FleetHandler)
+        self._server.fleet_router = router  # type: ignore[attr-defined]
+        self._server.fleet_draining = False  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="fleet-front")
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FleetServer":
+        self._thread.start()
+        return self
+
+    def begin_drain(self) -> None:
+        """Stop admission at the front (new requests 503, /readyz flips)
+        and on every replica; queued + in-flight work keeps running."""
+        self._server.fleet_draining = True  # type: ignore[attr-defined]
+        self.router.begin_drain()
+
+    def drain(self, grace_s: float = 5.0) -> bool:
+        """Fleet-wide graceful drain; the front keeps answering
+        /healthz, /readyz and /fleet/stats throughout."""
+        self._server.fleet_draining = True  # type: ignore[attr-defined]
+        return self.router.drain(grace_s)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self.router.stop()
+
+
+__all__ = [
+    "FleetClientError",
+    "FleetRouter",
+    "FleetServer",
+    "REPLICA_ACTIVE",
+    "REPLICA_DRAINING",
+    "REPLICA_STOPPED",
+    "Replica",
+    "check_fleet_ledger",
+    "spawn_local_replica",
+]

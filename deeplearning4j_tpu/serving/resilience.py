@@ -17,8 +17,11 @@ in numpy/jax.
 
 from __future__ import annotations
 
+import json
+import math
 import threading
 import time
+from http.server import ThreadingHTTPServer
 from typing import Callable, Optional
 
 
@@ -59,6 +62,16 @@ class DeadlineExceededError(ServingError, TimeoutError):
     served; expired work is shed *before* dispatch so timed-out clients
     stop costing device time.  Subclasses TimeoutError so existing
     ``except TimeoutError`` clients keep working; HTTP maps it to 504."""
+
+
+class UnservableShapeError(ServingError, ValueError):
+    """The request's dispatch shape falls outside the warmed bucket
+    ladder (the compile-count guard refused to mint program #N+1).  This
+    is the *client's* payload shape, not a server fault, so it also
+    subclasses ValueError and the HTTP layer maps it to 400 — never a
+    500.  Replaces the untyped ``RuntimeError`` the guard used to raise
+    (``ServingError`` keeps it a RuntimeError subclass for
+    backward-compatible ``except`` clauses)."""
 
 
 # Breaker states (the closed vocabulary /serving/stats and tests use):
@@ -177,6 +190,15 @@ class CircuitBreaker:
             self._probe_in_flight = True
             return True
 
+    def abandon_probe(self) -> None:
+        """Release a probe claim WITHOUT a verdict: the probed target
+        answered alive-but-unavailable (503 draining/overload, 504
+        deadline) — neither re-admission evidence nor a fault.  Keeps
+        the half-open window open for the next probe instead of wedging
+        it shut behind an in-flight claim that will never resolve."""
+        with self._lock:
+            self._probe_in_flight = False
+
     # ---- outcome recording ------------------------------------------------
 
     def record_success(self) -> None:
@@ -226,6 +248,105 @@ def check_admission(*, accepting: bool, breaker: Optional[CircuitBreaker],
             retry_after_s=retry_after_s())
 
 
+class ServingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with restart-after-drain semantics made
+    explicit, shared by both serving fronts (`ui/server.py`'s
+    `_UiHTTPServer` and `serving/fleet.py`'s `_FleetHTTPServer`):
+    SO_REUSEADDR so a drained-and-stopped server's port can be re-bound
+    by its replacement immediately (the rolling-swap / restart path must
+    not wait out TIME_WAIT), and daemon handler threads so a wedged
+    client connection cannot hold the process open."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class ServingHTTPMixin:
+    """Shared HTTP mechanics for the serving fronts — `ui/server.py`'s
+    `_Handler` and `serving/fleet.py`'s `_FleetHandler` mix this into
+    their `BaseHTTPRequestHandler`.  One copy of the JSON response
+    plumbing, the `deadline_ms`/`X-Deadline-Ms` deadline parse, and the
+    typed-failure -> status mapping this module's taxonomy promises, so
+    the two fronts cannot drift: a new typed error added here is mapped
+    once, in `respond_typed_failure`, and both fronts pick it up.
+
+    Stays stdlib-only (json/math) like the rest of the module; the
+    handler attributes used (`send_response`, `send_header`,
+    `end_headers`, `wfile`, `rfile`, `headers`) are
+    `BaseHTTPRequestHandler`'s."""
+
+    def log_message(self, fmt, *args):  # noqa: D102 — silence stderr
+        pass
+
+    def _send(self, code: int, ctype: str, data: bytes,
+              headers: Optional[dict] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _json(self, code: int, payload,
+              headers: Optional[dict] = None) -> None:
+        self._send(code, "application/json", json.dumps(payload).encode(),
+                   headers=headers)
+
+    def _body(self):
+        """Parse the JSON request body ({} when empty).  Raises
+        ValueError/JSONDecodeError on malformed JSON — the caller maps
+        it to 400."""
+        length = int(self.headers.get("Content-Length", 0))
+        if not length:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def _deadline_s(self, body) -> Optional[float]:
+        """Per-request deadline from the `deadline_ms` body field or the
+        `X-Deadline-Ms` header (body wins); None = no deadline.  A
+        malformed value is a client error (ValueError -> 400)."""
+        raw = None
+        if isinstance(body, dict) and body.get("deadline_ms") is not None:
+            raw = body["deadline_ms"]
+        elif self.headers.get("X-Deadline-Ms"):
+            raw = self.headers["X-Deadline-Ms"]
+        if raw is None:
+            return None
+        ms = float(raw)
+        if not math.isfinite(ms) or ms <= 0:
+            raise ValueError(f"deadline_ms must be a positive finite "
+                             f"number of milliseconds, got {raw!r}")
+        return ms / 1e3
+
+    def respond_typed_failure(self, e: BaseException) -> bool:
+        """Map this module's typed serving failures to their promised
+        status codes and answer the request; returns False (no response
+        written) for anything else so the caller applies its own
+        fallback policy.  Order matters: `UnservableShapeError` is a
+        ValueError and `DeadlineExceededError` a TimeoutError, so they
+        are matched before any broader clauses a caller might add."""
+        if isinstance(e, UnservableShapeError):
+            # the request's shape falls outside the warmed bucket ladder
+            # — the client's payload, not a server fault: 400, never 500
+            self._json(400, {"error": str(e)})
+            return True
+        if isinstance(e, DeadlineExceededError):
+            # the request's deadline passed before it could be served
+            self._json(504, {"error": str(e)})
+            return True
+        if isinstance(e, (ServingOverloadError, ServingUnavailableError)):
+            # admission refused (queue full / breaker open / draining):
+            # 503 + Retry-After so well-behaved clients back off
+            retry_after = max(1, math.ceil(
+                getattr(e, "retry_after_s", 1.0)))
+            self._json(503, {"error": str(e),
+                             "retry_after_s": retry_after},
+                       headers={"Retry-After": retry_after})
+            return True
+        return False
+
+
 __all__ = [
     "BREAKER_CLOSED",
     "BREAKER_HALF_OPEN",
@@ -234,7 +355,10 @@ __all__ = [
     "CircuitOpenError",
     "DeadlineExceededError",
     "ServingError",
+    "ServingHTTPMixin",
+    "ServingHTTPServer",
     "ServingOverloadError",
     "ServingUnavailableError",
+    "UnservableShapeError",
     "check_admission",
 ]

@@ -58,18 +58,23 @@ All payloads are JSON. `port=0` picks a free port (tests).
 from __future__ import annotations
 
 import json
-import math
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, List, Optional
 
 import numpy as np
 
 from deeplearning4j_tpu.serving.resilience import (
-    DeadlineExceededError,
-    ServingOverloadError,
+    ServingHTTPMixin,
+    ServingHTTPServer,
     ServingUnavailableError,
 )
+
+
+class _UiHTTPServer(ServingHTTPServer):
+    """Restart-after-drain socket semantics (SO_REUSEADDR + daemon
+    handler threads) live on the shared `ServingHTTPServer`
+    (serving/resilience.py), one copy for both serving fronts."""
 
 
 # Human-viewable dashboard (the reference served FreeMarker pages from the
@@ -167,52 +172,14 @@ class _UiState:
         self.draining = False  # set by UiServer.begin_drain (SIGTERM path)
 
 
-class _Handler(BaseHTTPRequestHandler):
-    # silence per-request stderr logging
-    def log_message(self, fmt, *args):  # noqa: D102
-        pass
+class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
+    # _send/_json/_body/_deadline_s + the typed-failure -> status
+    # mapping come from ServingHTTPMixin (serving/resilience.py), shared
+    # with the fleet front so the two HTTP contracts cannot drift.
 
     @property
     def state(self) -> _UiState:
         return self.server.ui_state  # type: ignore[attr-defined]
-
-    def _send(self, code: int, ctype: str, data: bytes,
-              headers: Optional[dict] = None) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(data)))
-        for k, v in (headers or {}).items():
-            self.send_header(k, str(v))
-        self.end_headers()
-        self.wfile.write(data)
-
-    def _json(self, code: int, payload: Any,
-              headers: Optional[dict] = None) -> None:
-        self._send(code, "application/json", json.dumps(payload).encode(),
-                   headers=headers)
-
-    def _deadline_s(self, body: Any) -> Optional[float]:
-        """Per-request deadline from the `deadline_ms` body field or the
-        `X-Deadline-Ms` header (body wins); None = no deadline.  A
-        malformed value is a client error (ValueError -> 400)."""
-        raw = None
-        if isinstance(body, dict) and body.get("deadline_ms") is not None:
-            raw = body["deadline_ms"]
-        elif self.headers.get("X-Deadline-Ms"):
-            raw = self.headers["X-Deadline-Ms"]
-        if raw is None:
-            return None
-        ms = float(raw)
-        if not math.isfinite(ms) or ms <= 0:
-            raise ValueError(f"deadline_ms must be a positive finite "
-                             f"number of milliseconds, got {raw!r}")
-        return ms / 1e3
-
-    def _body(self) -> Any:
-        length = int(self.headers.get("Content-Length", 0))
-        if not length:
-            return {}
-        return json.loads(self.rfile.read(length))
 
     def _html(self, body: str) -> None:
         self._send(200, "text/html; charset=utf-8", body.encode())
@@ -282,19 +249,13 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             self._route_post(body)
-        except DeadlineExceededError as e:
-            # the request's deadline passed before it could be served
-            self._json(504, {"error": str(e)})
-        except (ServingOverloadError, ServingUnavailableError) as e:
-            # admission refused (queue full / breaker open / draining):
-            # 503 + Retry-After so well-behaved clients back off
-            retry_after = max(1, math.ceil(
-                getattr(e, "retry_after_s", 1.0)))
-            self._json(503, {"error": str(e),
-                             "retry_after_s": retry_after},
-                       headers={"Retry-After": retry_after})
         except Exception as e:  # noqa: BLE001 — surface as 400, keep serving
-            self._json(400, {"error": repr(e)})
+            # typed serving failures (UnservableShapeError -> 400,
+            # DeadlineExceededError -> 504, overload/unavailable -> 503
+            # + Retry-After) map via the shared mixin; anything else is
+            # surfaced as 400 so the UI server keeps serving
+            if not self.respond_typed_failure(e):
+                self._json(400, {"error": repr(e)})
 
     def _route_post(self, body: Any) -> None:
         s = self.state
@@ -369,8 +330,16 @@ class _Handler(BaseHTTPRequestHandler):
             # request's rows ride whatever coalesced dispatch the
             # micro-batcher forms with concurrently-arriving requests.
             with s.lock:
-                engine = s.engine
+                engine, stopping = s.engine, s.draining
             if engine is None:
+                if stopping:
+                    # the model WAS here — the server is draining or
+                    # mid-stop (stop() nulls the engine while handler
+                    # threads may still be running).  503, never 400: a
+                    # fleet router must fail this request over, not
+                    # blame the payload
+                    raise ServingUnavailableError(
+                        "server stopped: model unregistered")
                 self._json(400, {"error": "no model registered: call "
                                           "UiServer.serve_model(net)"})
                 return
@@ -400,7 +369,13 @@ class _Handler(BaseHTTPRequestHandler):
         s = self.state
         with s.lock:
             lm, lm_server = s.lm, s.lm_server
+            stopping = s.draining
         if lm is None:
+            if stopping:
+                # same stop-race rule as /model/predict: a draining or
+                # stopped server answers 503 (fail over), never 400
+                raise ServingUnavailableError(
+                    "server stopped: LM unregistered")
             self._json(400, {"error": "no LM registered: call "
                                       "UiServer.serve_lm(cfg, params)"})
             return
@@ -477,7 +452,7 @@ class UiServer:
     """`UiServer(port=0).start()`; `.url` for clients; `.stop()` to halt."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8080):
-        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server = _UiHTTPServer((host, port), _Handler)
         self._server.ui_state = _UiState()  # type: ignore[attr-defined]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
@@ -606,8 +581,16 @@ class UiServer:
         self._server.shutdown()
         self._server.server_close()
         with self.state.lock:
+            # handler threads that got in before the close may read the
+            # nulled planes: `draining` makes them answer 503 (so a
+            # fleet router fails over), not 400.  `lm` must null too —
+            # a non-None (cfg, params) would route a stop-racing
+            # /lm/generate down the unmanaged whole-sequence fallback
+            # (fresh compile, no admission) instead of the 503
+            self.state.draining = True
             engine, lm_server = self.state.engine, self.state.lm_server
             self.state.engine = None
+            self.state.lm = None
             self.state.lm_server = None
         if engine is not None:
             engine.stop()

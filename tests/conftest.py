@@ -38,6 +38,10 @@ def pytest_configure(config):
         "markers", "precision: precision-plane invariants — bf16 mixed "
         "parity/determinism, loss-scaler overflow recovery, int8 serving "
         "agreement, dtype round-trips (fast; run in tier-1)")
+    config.addinivalue_line(
+        "markers", "fleet: serving-fleet tests — failover router, health "
+        "ejection/re-admission, rolling weight swaps, fleet chaos (fast; "
+        "run in tier-1)")
 
 
 @pytest.fixture

@@ -1,0 +1,997 @@
+"""Serving-fleet tests (ISSUE-6 acceptance surface).
+
+Covers: least-loaded + prefix-affinity routing, failover resubmission
+(the chaos acceptance: a concurrency-32 storm with one replica
+hard-killed mid-storm completes with ZERO failed requests), /readyz-
+driven health ejection with half-open re-admission (flapping-readyz
+chaos), rolling weight swaps under live traffic with zero 5xx,
+queue-depth autoscale through graceful drain, the fleet HTTP front
+(`/fleet/stats`, typed-status mapping, fleet-wide drain), the
+cross-replica ledger invariant, the `UnservableShapeError` -> 400
+mapping, restart-after-drain port reuse (SO_REUSEADDR), process-replica
+command generation, and the `serve-fleet` CLI — all deterministic on
+CPU via `FleetChaosConfig`/`chaos_fleet`.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork, iris_mlp
+from deeplearning4j_tpu.resilience import FleetChaosConfig, chaos_fleet
+from deeplearning4j_tpu.serving import (
+    BucketLadder,
+    FleetClientError,
+    FleetRouter,
+    FleetServer,
+    Replica,
+    ServingUnavailableError,
+    UnservableShapeError,
+    check_fleet_ledger,
+    spawn_local_replica,
+)
+from deeplearning4j_tpu.serving.resilience import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    ServingError,
+)
+
+pytestmark = [pytest.mark.fleet, pytest.mark.serving, pytest.mark.chaos]
+
+
+def _mlp(seed: int = 0):
+    return MultiLayerNetwork(iris_mlp()).init(jax.random.PRNGKey(seed))
+
+
+_WARM = np.zeros((4,), np.float32)
+
+
+def _factory(net, **kw):
+    """A replica factory serving `net` on the (1, 8) ladder, warmed."""
+
+    def factory(name):
+        return spawn_local_replica(
+            name, net, ladder=BucketLadder((1, 8)), max_wait_ms=1.0,
+            warmup_example=_WARM, **kw)
+
+    return factory
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# routing
+
+
+class TestRouting:
+    def _bare_router(self, names=("a", "b", "c")):
+        """Router over attached (never-dispatched) replicas — picking
+        logic only, no HTTP."""
+        router = FleetRouter()
+        for n in names:
+            router.attach(Replica(n, f"http://127.0.0.1:1/{n}"))
+        return router
+
+    def test_least_loaded_with_deterministic_ties(self):
+        router = self._bare_router()
+        a, b, c = router.replicas()
+        assert router._pick().name == "a"          # tie -> name order
+        a.in_flight, b.in_flight = 2, 1
+        assert router._pick().name == "c"
+        c.in_flight = 3
+        assert router._pick().name == "b"
+
+    def test_excluded_set_and_exhaustion(self):
+        router = self._bare_router()
+        assert router._pick(frozenset({"a"})).name == "b"
+        assert router._pick(frozenset({"a", "b"})).name == "c"
+        assert router._pick(frozenset({"a", "b", "c"})) is None
+
+    def test_ejected_replica_not_routable(self):
+        router = self._bare_router(("a", "b"))
+        a, b = router.replicas()
+        for _ in range(router.replica_breaker_threshold):
+            a.breaker.record_failure()
+        assert a.breaker.state == BREAKER_OPEN
+        assert not a.routable()
+        assert router._pick().name == "b"
+
+    def test_affinity_stable_and_spills_under_skew(self):
+        router = self._bare_router()
+        picks = {router._pick(key="prefix-1").name for _ in range(8)}
+        assert len(picks) == 1                     # deterministic
+        preferred = picks.pop()
+        # a DIFFERENT key may (and for some key will) prefer another
+        # replica: rendezvous hashing spreads keys across the fleet
+        spread = {router._pick(key=f"prefix-{i}").name for i in range(32)}
+        assert len(spread) > 1
+        # back up the preferred replica beyond the spill depth: the
+        # affinity yields to least-loaded
+        for r in router.replicas():
+            if r.name == preferred:
+                r.in_flight = router.affinity_spill_depth + 1
+        assert router._pick(key="prefix-1").name != preferred
+
+    def test_no_replica_raises_typed_and_counts_rejected(self):
+        router = FleetRouter()
+        with pytest.raises(ServingUnavailableError, match="no routable"):
+            router.predict_proba(np.zeros((1, 4), np.float32))
+        assert router.metrics.snapshot()["rejected"] == 1
+
+
+# ---------------------------------------------------------------------------
+# failover: the chaos acceptance scenario
+
+
+class TestFailover:
+    def test_mid_storm_replica_kill_zero_failed_requests(self):
+        """ISSUE-6 acceptance: concurrency-32 storm, one replica
+        hard-killed mid-storm, every request completes (rerouted)."""
+        net = _mlp()
+        conc, total = 32, 96
+        router = FleetRouter(_factory(net), replicas=3,
+                             request_timeout_s=60.0)
+        chaos = chaos_fleet(router, FleetChaosConfig(kill_at_attempt=24))
+        rng = np.random.default_rng(0)
+        reqs = rng.random((total, 1, 4)).astype(np.float32)
+        results = [None] * total
+        errors = []
+        barrier = threading.Barrier(conc)
+
+        def client(cid):
+            try:
+                barrier.wait()
+                for i in range(cid, total, conc):
+                    results[i] = router.predict_proba(reqs[i], timeout=60)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(conc)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            chaos.uninstall()
+            # the control plane discovers the death the honest way:
+            # readyz probes fail until the breaker ejects the corpse
+            # (dispatch failures during the storm may already have)
+            dead = next(r for r in router.replicas()
+                        if r.name == chaos.killed[0])
+            for _ in range(10):
+                if not dead.routable():
+                    break
+                router.poll_health_once()
+            assert not dead.routable()
+            stats = router.fleet_stats(include_replica_stats=False)
+        finally:
+            router.stop()
+        assert not errors, errors                  # ZERO failed requests
+        assert len(chaos.killed) == 1              # the kill happened
+        assert router.failovers >= 1               # and was rerouted
+        assert stats["fleet"]["requests"] == total
+        assert stats["fleet"]["replicas_routable"] == 2
+        # rerouted answers are REAL answers: numerically the net's own
+        expected = np.asarray(net.output(reqs[5]))
+        np.testing.assert_allclose(results[5], expected, atol=1e-5)
+
+    def test_dead_endpoint_fails_over_and_ejects(self):
+        """A replica that was never reachable costs failovers until its
+        breaker ejects it — then traffic stops even trying."""
+        net = _mlp()
+        router = FleetRouter(replica_breaker_threshold=2)
+        # an address nothing listens on (port 1 is root-reserved)
+        dead = router.attach(Replica("dead", "http://127.0.0.1:1"))
+        dead.in_flight = -1                # least-loaded prefers it
+        router.attach(_factory(net)("live"))
+        x = np.zeros((1, 4), np.float32)
+        try:
+            for _ in range(router.replica_breaker_threshold):
+                router.predict_proba(x, timeout=30)
+            assert dead.breaker.state == BREAKER_OPEN
+            assert dead.failures == router.replica_breaker_threshold
+            assert not dead.routable()
+            assert router.failovers == router.replica_breaker_threshold
+            before = router.failovers
+            router.predict_proba(x, timeout=30)    # no attempt at dead
+            assert router.failovers == before
+        finally:
+            router.stop()
+
+    def test_half_open_replica_is_last_resort_with_single_probe(self):
+        """An ejected replica whose cooldown elapsed (half-open) must
+        not be PREFERRED by least-loaded — its in_flight is ~0 precisely
+        because it got no traffic — and at most one request rides its
+        re-admission probe; concurrent attempts are refused penalty-free
+        instead of piling onto a replica the breaker has not re-admitted."""
+        from deeplearning4j_tpu.serving.fleet import _ReplicaDispatchError
+
+        router = FleetRouter()
+        a = router.attach(Replica(
+            "a", "http://127.0.0.1:1/a",
+            breaker=CircuitBreaker(failure_threshold=1, cooldown_s=0.0)))
+        b = router.attach(Replica("b", "http://127.0.0.1:1/b"))
+        a.breaker.record_failure()
+        assert a.breaker.state == BREAKER_HALF_OPEN   # cooldown elapsed
+        b.in_flight = 5
+        # the loaded-but-healthy replica still wins over the idle corpse
+        assert router._pick().name == "b"
+        assert router._pick(key="prefix-1").name == "b"
+        # last resort: only when no healthy replica remains
+        assert router._pick(frozenset({"b"})).name == "a"
+        # claim the probe, then a concurrent dispatch attempt is refused
+        # penalty-free (no network touched, no failure recorded)
+        assert a.breaker.allow_dispatch()
+        with pytest.raises(_ReplicaDispatchError, match="probe already"):
+            router._dispatch(a, "/model/predict", {})
+        assert a.failures == 0
+        a.breaker.abandon_probe()
+
+    def test_lm_sampling_modes_forward_through_router(self):
+        """top-k / top-p / beam must ride the router body to the
+        replica's whole-sequence leg — a silent downgrade to greedy
+        would answer 200 with DIFFERENT generations than the
+        single-server surface.  Defaults stay off the wire so plain
+        requests keep hitting the continuous pool."""
+        router = FleetRouter()
+        seen = {}
+
+        def fake_submit(path, body, key=None, timeout=None):
+            seen["path"], seen["body"] = path, body
+            return {"ids": [1]}
+
+        router._submit = fake_submit
+        router.generate([7, 8], 4, temperature=0.7, seed=3,
+                        top_k=5, top_p=0.9, beam_size=3)
+        assert seen["path"] == "/lm/generate"
+        assert seen["body"]["top_k"] == 5
+        assert seen["body"]["top_p"] == 0.9
+        assert seen["body"]["beam_size"] == 3
+        router.generate([7, 8], 4)
+        assert "top_k" not in seen["body"]
+        assert "top_p" not in seen["body"]
+        assert "beam_size" not in seen["body"]
+
+    def test_failover_deadline_budget_shrinks_then_exhausts(self):
+        """The client deadline is a TOTAL budget across failovers: each
+        retry forwards only what remains, and when the budget runs out
+        mid-failover the router raises a typed 504 instead of granting
+        every attempt a fresh full deadline."""
+        from deeplearning4j_tpu.serving.fleet import _ReplicaDispatchError
+        from deeplearning4j_tpu.serving.resilience import (
+            DeadlineExceededError,
+        )
+
+        router = FleetRouter()
+        for n in ("a", "b", "c"):
+            router.attach(Replica(n, f"http://127.0.0.1:1/{n}"))
+        forwarded = []
+
+        def slow_failing_dispatch(replica, path, body, timeout=None):
+            forwarded.append(body["deadline_ms"])
+            time.sleep(0.05)
+            raise _ReplicaDispatchError("boom", replica_fault=True)
+
+        router._dispatch = slow_failing_dispatch
+        with pytest.raises(DeadlineExceededError, match="exhausted"):
+            router.predict_proba(np.zeros((1, 4), np.float32),
+                                 deadline_s=0.08)
+        # the budget never exhausted all three replicas: it ran out
+        # after two ~50ms attempts, and each retry saw a smaller budget
+        assert 1 <= len(forwarded) < 3
+        assert all(later < earlier for earlier, later
+                   in zip(forwarded, forwarded[1:]))
+        assert forwarded[0] <= 80.0
+        snap = router.metrics.snapshot()
+        assert snap["deadline_missed"] == 1
+        assert snap["rejected"] == 1       # the ledger still balances
+
+    def test_client_error_propagates_without_failover(self):
+        """4xx from a replica is the PAYLOAD's fault: the router must
+        not burn a retry on another replica (satellite: the compile-
+        count guard's `UnservableShapeError` maps to 400, not 500)."""
+        net = _mlp()
+        router = FleetRouter(_factory(net), replicas=2)
+        try:
+            for r in router.replicas():
+                # leave ONE warmed program per replica: a 2-row request
+                # needs the 8-bucket -> guard refuses -> 400
+                r.server.state.engine.max_programs = 1
+                r.server.state.engine._seen_shapes = {"<f4": {(1, 4)}}
+            with pytest.raises(FleetClientError) as exc:
+                router.predict_proba(np.zeros((2, 4), np.float32),
+                                     timeout=30)
+            assert exc.value.status == 400
+            assert "compile-count guard" in str(exc.value)
+            assert router.failovers == 0
+            # a 4xx is a typed rejection in the router's ledger, so
+            # client_balanced (submitted == requests + rejected) holds
+            assert router.metrics.snapshot()["rejected"] == 1
+        finally:
+            router.stop()
+
+
+# ---------------------------------------------------------------------------
+# health: eject -> half-open probe -> re-admit
+
+
+class TestHealthLifecycle:
+    def test_flaky_readyz_ejects_then_readmits(self):
+        net = _mlp()
+        router = FleetRouter(_factory(net), replicas=2,
+                             replica_breaker_threshold=2,
+                             replica_breaker_cooldown_s=0.3)
+        chaos = chaos_fleet(router, FleetChaosConfig(
+            flaky_readyz_polls=(0, 1), flaky_replica="replica-0"))
+        try:
+            victim = router.replicas()[0]
+            assert router.poll_health_once()["replica-0"] is False
+            assert victim.routable()               # 1 failure < threshold
+            assert router.poll_health_once()["replica-0"] is False
+            assert not victim.routable()           # ejected
+            assert victim.ejections == 1
+            # inside the cooldown the replica is not even probed
+            assert "replica-0" not in router.poll_health_once()
+            time.sleep(0.35)
+            # cooldown elapsed: the next probe IS the re-admission test
+            # (poll index 2 — the flap is over, the replica is fine)
+            assert router.poll_health_once()["replica-0"] is True
+            assert victim.routable()
+            assert victim.readmissions == 1
+            assert victim.breaker.state == BREAKER_CLOSED
+        finally:
+            chaos.uninstall()
+            router.stop()
+
+    def test_killed_replica_ejected_by_health_polls(self):
+        net = _mlp()
+        router = FleetRouter(_factory(net), replicas=2,
+                             replica_breaker_threshold=2,
+                             probe_timeout_s=1.0)
+        try:
+            victim = router.replicas()[0]
+            victim.kill()
+            for _ in range(router.replica_breaker_threshold):
+                assert router.poll_health_once()["replica-0"] is False
+            assert not victim.routable()
+            stats = router.fleet_stats(include_replica_stats=False)
+            assert stats["fleet"]["replicas_routable"] == 1
+            assert stats["fleet"]["health_polls"] == 2
+        finally:
+            router.stop()
+
+    def test_green_readyz_does_not_erase_dispatch_failures(self):
+        """A replica that 500s every dispatch while its /readyz stays
+        green must still be ejected: a green probe on a CLOSED breaker
+        records nothing (only a half-open probe success re-admits), so
+        health sweeps cannot reset the dispatch-failure streak and keep
+        a broken-but-green replica in rotation forever."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class _BrokenButGreen(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                body = b'{"ready": true}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                self.send_response(500)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _BrokenButGreen)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        net = _mlp()
+        router = FleetRouter(_factory(net), replicas=1,
+                             replica_breaker_threshold=2)
+        broken = router.attach(Replica(
+            "broken", f"http://127.0.0.1:{srv.server_address[1]}"))
+        broken.in_flight = -1                  # least-loaded prefers it
+        x = np.zeros((1, 4), np.float32)
+        try:
+            router.predict_proba(x, timeout=30)      # dispatch failure 1
+            # a green health sweep between the dispatch failures must
+            # not reset the broken replica's consecutive-failure count
+            assert router.poll_health_once()["broken"] is True
+            assert broken.routable()           # 1 failure < threshold
+            router.predict_proba(x, timeout=30)      # dispatch failure 2
+            assert broken.breaker.state == BREAKER_OPEN
+            assert not broken.routable()
+            assert broken.failures == 2
+            assert router.failovers == 2
+        finally:
+            router.stop()
+            srv.shutdown()
+            srv.server_close()
+
+    def test_garbage_body_endpoint_fails_over_and_probes_not_ready(self):
+        """A misconfigured attached endpoint answering 200 with a
+        non-JSON body is a replica fault: dispatch fails over to a
+        healthy replica instead of crashing the client, and a health
+        probe records not-ready instead of letting the JSON error kill
+        the health daemon thread."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class _Garbage(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _answer(self):
+                body = b"<html>misconfigured proxy</html>"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = _answer
+            do_POST = _answer
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _Garbage)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        net = _mlp()
+        router = FleetRouter(_factory(net), replicas=1)
+        bad = router.attach(Replica(
+            "bad", f"http://127.0.0.1:{srv.server_address[1]}"))
+        bad.in_flight = -1                     # least-loaded prefers it
+        try:
+            out = router.predict_proba(np.zeros((1, 4), np.float32),
+                                       timeout=30)
+            assert out.shape == (1, 3)
+            assert router.failovers == 1
+            assert bad.failures == 1           # breaker-worthy fault
+            assert router.poll_health_once()["bad"] is False
+        finally:
+            router.stop()
+            srv.shutdown()
+            srv.server_close()
+
+    def test_health_loop_thread_start_stop(self):
+        net = _mlp()
+        router = FleetRouter(_factory(net), replicas=1)
+        try:
+            router.start_health_loop(interval_s=0.05)
+            deadline = time.monotonic() + 10
+            while router.health_polls < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert router.health_polls >= 2
+        finally:
+            router.stop()          # stops the loop + the replica
+        assert router._health_thread is None
+
+
+# ---------------------------------------------------------------------------
+# rolling weight swap
+
+
+class TestRollingSwap:
+    def test_swap_under_live_traffic_zero_5xx(self):
+        """ISSUE-6 acceptance: a rolling weight swap under live traffic
+        serves zero 5xx, and afterwards every answer comes from the NEW
+        weights."""
+        old_net, new_net = _mlp(seed=0), _mlp(seed=1)
+        x = np.linspace(0, 1, 4, dtype=np.float32).reshape(1, 4)
+        old_out = np.asarray(old_net.output(x))
+        new_out = np.asarray(new_net.output(x))
+        assert not np.allclose(old_out, new_out)   # distinguishable
+        router = FleetRouter(_factory(old_net), replicas=2)
+        np.testing.assert_allclose(
+            router.predict_proba(x, timeout=30), old_out, atol=1e-5)
+        stop = threading.Event()
+        errors = []
+
+        def live_client():
+            while not stop.is_set():
+                try:
+                    out = router.predict_proba(x, timeout=30)
+                except BaseException as e:  # noqa: BLE001 — surfaced below
+                    errors.append(e)
+                    return
+                # every in-flight answer is one of the two weight sets,
+                # never garbage from a half-swapped replica
+                if not (np.allclose(out, old_out, atol=1e-5)
+                        or np.allclose(out, new_out, atol=1e-5)):
+                    errors.append(AssertionError(f"mixed weights: {out}"))
+                    return
+
+        clients = [threading.Thread(target=live_client) for _ in range(4)]
+        try:
+            for t in clients:
+                t.start()
+            steps = router.rolling_swap(_factory(new_net), grace_s=10.0)
+        finally:
+            stop.set()
+            for t in clients:
+                t.join(timeout=30)
+        try:
+            assert not errors, errors              # zero 5xx / failures
+            assert len(steps) == 2
+            assert all(s["drained"] for s in steps)
+            replicas = router.replicas()
+            assert len(replicas) == 2
+            assert all(r.version == 1 for r in replicas)
+            assert {r.name for r in replicas} == {"replica-2", "replica-3"}
+            assert router.swaps == 1
+            # the flip is complete: answers are the new weights
+            np.testing.assert_allclose(
+                router.predict_proba(x, timeout=30), new_out, atol=1e-5)
+        finally:
+            router.stop()
+
+
+# ---------------------------------------------------------------------------
+# queue-depth autoscale
+
+
+class TestAutoscale:
+    def test_scale_up_then_down_through_drain(self):
+        net = _mlp()
+        router = FleetRouter(_factory(net), replicas=1,
+                             min_replicas=1, max_replicas=2,
+                             scale_up_depth=2.0, scale_down_depth=0.5)
+        try:
+            first = router.replicas()[0]
+            first.in_flight = 5                    # synthetic backlog
+            assert router.autoscale_tick() == 1
+            assert router.scale_ups == 1
+            assert len(router.replicas()) == 2
+            first.in_flight = 5
+            assert router.autoscale_tick() == 0    # at max_replicas
+            first.in_flight = 0
+            assert router.autoscale_tick(grace_s=5.0) == -1
+            assert router.scale_downs == 1
+            assert len(router.replicas()) == 1
+            assert router.autoscale_tick() == 0    # at min_replicas
+        finally:
+            router.stop()
+
+    def test_health_loop_drives_autoscale_when_enabled(self):
+        net = _mlp()
+        router = FleetRouter(_factory(net), replicas=1,
+                             min_replicas=1, max_replicas=2,
+                             scale_up_depth=2.0, scale_down_depth=-1.0)
+        router.autoscale = True
+        try:
+            router.replicas()[0].in_flight = 5
+            router.poll_health_once()
+            assert router.scale_ups == 1
+            assert len(router.replicas()) == 2
+        finally:
+            router.stop()
+
+
+# ---------------------------------------------------------------------------
+# ledger invariant (satellite)
+
+
+class TestFleetLedger:
+    def test_ledger_balances_after_rolling_swap(self):
+        """Retired replicas' final counts fold into the `retired`
+        aggregate when `remove()` takes them out, so the ledger keeps
+        balancing across membership changes — a healthy fleet must not
+        report its pre-swap requests as lost forever."""
+        net = _mlp()
+        router = FleetRouter(_factory(net), replicas=2)
+        x = np.zeros((1, 4), np.float32)
+        try:
+            for _ in range(6):
+                router.predict_proba(x, timeout=30)
+            router.rolling_swap(_factory(net))
+            for _ in range(4):
+                router.predict_proba(x, timeout=30)
+            stats = router.fleet_stats()
+            assert stats["retired"]["aggregate"]["requests"] == 6
+            assert stats["retired"]["lost"] == 0
+            assert stats["ledger"]["balanced"] is True
+            assert stats["ledger"]["fleet_requests"] == 10
+            assert check_fleet_ledger(
+                stats, submitted=10)["client_balanced"] is True
+        finally:
+            router.stop()
+
+    def test_ledger_balances_across_replicas(self):
+        net = _mlp()
+        conc, total = 8, 64
+        router = FleetRouter(_factory(net), replicas=2)
+        rng = np.random.default_rng(1)
+        reqs = rng.random((total, 1, 4)).astype(np.float32)
+        errors = []
+
+        def client(cid):
+            try:
+                for i in range(cid, total, conc):
+                    router.predict_proba(reqs[i], timeout=60)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(conc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        try:
+            assert not errors, errors
+            stats = router.fleet_stats()
+            ledger = stats["ledger"]
+            assert ledger["replicas_reachable"] is True
+            # every answered request was answered by exactly ONE replica
+            assert ledger["balanced"] is True
+            assert ledger["fleet_requests"] == total
+            # both replicas actually served (least-loaded spreads work)
+            served = [e["stats"]["classifier"]["requests"]
+                      for e in stats["replicas"]]
+            assert sum(served) == total and all(s > 0 for s in served)
+            # client-side: submitted == answered + rejected
+            ledger = check_fleet_ledger(stats, submitted=total)
+            assert ledger["client_balanced"] is True
+        finally:
+            router.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet HTTP front
+
+
+class TestFleetServerHTTP:
+    def test_predict_stats_and_readiness(self):
+        net = _mlp()
+        router = FleetRouter(_factory(net), replicas=2)
+        front = FleetServer(router, port=0).start()
+        try:
+            assert _get(front.url + "/healthz") == {"ok": True}
+            assert _get(front.url + "/readyz") == {"ready": True}
+            x = np.eye(4, dtype=np.float32)[:2]
+            payload = _post(front.url + "/model/predict",
+                            {"features": x.tolist()})
+            np.testing.assert_allclose(
+                payload["outputs"], np.asarray(net.output(x)), atol=1e-5)
+            assert payload["predictions"] == list(
+                np.argmax(np.asarray(net.output(x)), axis=-1))
+            stats = _get(front.url + "/fleet/stats")
+            assert stats["fleet"]["requests"] == 1
+            assert stats["fleet"]["replicas_routable"] == 2
+            assert len(stats["replicas"]) == 2
+            assert stats["ledger"]["balanced"] is True
+            # /serving/stats is the cheap view: no per-replica fan-out
+            cheap = _get(front.url + "/serving/stats")
+            assert "ledger" not in cheap
+            assert "stats" not in cheap["replicas"][0]
+        finally:
+            front.stop()
+
+    def test_error_mapping_400_and_503(self):
+        net = _mlp()
+        router = FleetRouter(_factory(net), replicas=1)
+        front = FleetServer(router, port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(front.url + "/model/predict", {"features": []})
+            assert exc.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(front.url + "/model/predict",
+                      {"features": [[0.0] * 4], "deadline_ms": -5})
+            assert exc.value.code == 400
+            # a replica 4xx surfaces with the replica's status code
+            replica = router.replicas()[0]
+            replica.server.state.engine.max_programs = 1
+            replica.server.state.engine._seen_shapes = {
+                "<f4": {(1, 4)}}
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(front.url + "/model/predict",
+                      {"features": [[0.0] * 4] * 2})
+            assert exc.value.code == 400
+            assert "compile-count guard" in json.loads(
+                exc.value.read())["error"]
+            # with no routable replica the front answers 503, not 500
+            replica.state = "draining"
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(front.url + "/model/predict",
+                      {"features": [[0.0] * 4]})
+            assert exc.value.code == 503
+            assert exc.value.headers["Retry-After"]
+            ready = urllib.request.urlopen(  # /readyz flips too
+                front.url + "/readyz", timeout=30)
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.loads(e.read())["reasons"] == [
+                "no routable replica"]
+        else:
+            pytest.fail(f"/readyz stayed ready: {ready.status}")
+        finally:
+            front.stop()
+
+    def test_fleet_wide_drain_stops_admission_keeps_introspection(self):
+        net = _mlp()
+        router = FleetRouter(_factory(net), replicas=2)
+        front = FleetServer(router, port=0).start()
+        try:
+            _post(front.url + "/model/predict",
+                  {"features": [[0.0] * 4]})
+            assert front.drain(grace_s=5.0) is True
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(front.url + "/model/predict",
+                      {"features": [[0.0] * 4]})
+            assert exc.value.code == 503
+            assert "draining" in json.loads(exc.value.read())["error"]
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(front.url + "/readyz")
+            assert exc.value.code == 503
+            # introspection keeps answering through the drain
+            assert _get(front.url + "/healthz") == {"ok": True}
+            stats = _get(front.url + "/serving/stats")
+            assert stats["fleet"]["requests"] == 1
+        finally:
+            front.stop()
+
+
+# ---------------------------------------------------------------------------
+# restart after drain (satellite): the port is immediately rebindable
+
+
+class TestRestartAfterDrain:
+    def test_stop_nulls_every_serving_plane(self):
+        """stop() must null `lm` alongside `engine`/`lm_server`: a
+        handler thread racing the stop would otherwise read a non-None
+        (cfg, params) and route /lm/generate down the unmanaged
+        whole-sequence fallback (200 from a stopped server) instead of
+        the stop-race 503 the router fails over on."""
+        from deeplearning4j_tpu.ui.server import UiServer
+
+        srv = UiServer(port=0).start()
+        srv.state.lm = ("cfg", "params")     # as serve_lm would set
+        srv.stop()
+        assert srv.state.lm is None
+        assert srv.state.lm_server is None
+        assert srv.state.engine is None
+        assert srv.state.draining is True
+
+    def test_drained_server_port_rebinds_immediately(self):
+        from deeplearning4j_tpu.ui.server import UiServer, _UiHTTPServer
+
+        assert _UiHTTPServer.allow_reuse_address is True
+        net = _mlp()
+
+        def serve_on(port):
+            return UiServer(port=port).serve_model(
+                net, max_batch=8, ladder=BucketLadder((1, 8)),
+                warmup_example=_WARM).start()
+
+        srv = serve_on(0)
+        port = int(srv.url.rsplit(":", 1)[1])
+        _post(srv.url + "/model/predict", {"features": [[0.0] * 4]})
+        assert srv.drain(grace_s=5.0) is True
+        srv.stop()
+        # the replacement binds the SAME port with zero wait — the
+        # just-closed listener leaves sockets in TIME_WAIT, and
+        # SO_REUSEADDR is what makes rebinding legal despite them
+        srv2 = serve_on(port)
+        try:
+            assert srv2.url == srv.url
+            payload = _post(srv2.url + "/model/predict",
+                            {"features": [[0.0] * 4]})
+            assert len(payload["predictions"]) == 1
+        finally:
+            srv2.stop()
+
+    def test_ledger_survives_drain(self):
+        """The drained server's final stats still satisfy the ledger
+        invariant: submitted == requests + rejected + shed."""
+        from deeplearning4j_tpu.ui.server import UiServer
+
+        net = _mlp()
+        srv = UiServer(port=0).serve_model(
+            net, max_batch=8, ladder=BucketLadder((1, 8)),
+            warmup_example=_WARM).start()
+        try:
+            submitted = 5
+            for _ in range(submitted):
+                _post(srv.url + "/model/predict",
+                      {"features": [[0.0] * 4]})
+            srv.drain(grace_s=5.0)
+            snap = srv.serving_stats()["classifier"]
+            assert (snap["requests"] + snap["rejected"] + snap["shed"]
+                    == submitted)
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# process-per-replica launching (command generation; tier-1 stays CPU-cheap)
+
+
+class TestProcessLauncher:
+    def test_replica_commands_and_urls(self):
+        from deeplearning4j_tpu.runtime.launcher import (
+            FleetProcessLauncher,
+            replica_serve_command,
+        )
+
+        launcher = FleetProcessLauncher(
+            "out/model", n_replicas=3, base_port=9000, buckets="1,8",
+            max_queue=64, deadline_ms=500.0, breaker_threshold=4,
+            quantize="int8")
+        assert launcher.urls() == [f"http://127.0.0.1:{9000 + i}"
+                                   for i in range(3)]
+        cmd = launcher.command(1)
+        assert cmd[1:4] == ["-m", "deeplearning4j_tpu.cli", "serve"]
+        for flag, val in [("-model", "out/model"), ("-port", "9001"),
+                          ("-buckets", "1,8"), ("-max-queue", "64"),
+                          ("-deadline-ms", "500.0"),
+                          ("-breaker-threshold", "4"),
+                          ("-quantize", "int8")]:
+            assert cmd[cmd.index(flag) + 1] == val
+        assert "-warmup" in cmd
+        bare = replica_serve_command("m", warmup=False)
+        assert "-warmup" not in bare and "-max-queue" not in bare
+
+    def test_attach_all_waits_for_readyz(self):
+        """attach_all must not put a cold worker into rotation: a fresh
+        Replica is ACTIVE with a closed breaker (routable the moment it
+        is attached), so each worker joins only after its /readyz goes
+        green — and a worker that never binds raises instead of the
+        router discovering a corpse through live traffic."""
+        from deeplearning4j_tpu.runtime.launcher import FleetProcessLauncher
+
+        net = _mlp()
+        backing = _factory(net)("backing")     # a real ready endpoint
+        port = int(backing.url.rsplit(":", 1)[1])
+        router = FleetRouter()
+        try:
+            launcher = FleetProcessLauncher("m", n_replicas=1,
+                                            base_port=port)
+            launcher.spawn = lambda i: None    # worker already "up"
+            out = launcher.attach_all(router, ready_timeout_s=30.0)
+            # "worker-", not "replica-": must never collide with the
+            # router factory's replica-{seq} names (exclusion is by name)
+            assert [r.name for r in out] == ["worker-0"]
+            assert out[0].routable()
+            probs = router.predict_proba(np.zeros((1, 4), np.float32),
+                                         timeout=30)
+            assert probs.shape[0] == 1
+
+            cold = FleetProcessLauncher("m", n_replicas=1, base_port=1)
+            cold.spawn = lambda i: None        # port 1: never binds
+            with pytest.raises(TimeoutError):
+                cold.attach_all(router, ready_timeout_s=0.6)
+            assert len(router.replicas()) == 1  # the corpse not attached
+        finally:
+            router.stop()
+            backing.stop()
+
+
+# ---------------------------------------------------------------------------
+# serve-fleet CLI
+
+
+class TestCliServeFleet:
+    def test_boots_serves_and_reports(self):
+        import contextlib
+        import io
+        import re
+
+        from deeplearning4j_tpu.cli import main as cli_main
+
+        out = io.StringIO()
+        rc = {}
+
+        def run():
+            with contextlib.redirect_stdout(out):
+                rc["rc"] = cli_main(
+                    ["serve-fleet", "-model", "zoo:iris-mlp", "-port",
+                     "0", "-replicas", "2", "-warmup", "-buckets", "1,8",
+                     "-health-interval-s", "0.2", "-serve-seconds", "8"])
+
+        t = threading.Thread(target=run)
+        t.start()
+        url = None
+        for _ in range(300):
+            m = re.search(r"Serving fleet on (http://\S+)",
+                          out.getvalue())
+            if m:
+                url = m.group(1)
+                break
+            time.sleep(0.1)
+        assert url, out.getvalue()
+        assert "2 warm replicas in rotation" in out.getvalue()
+        assert _get(url + "/healthz") == {"ok": True}
+        assert _get(url + "/readyz") == {"ready": True}
+        payload = _post(url + "/model/predict",
+                        {"features": [[0.0] * 4]})
+        assert len(payload["predictions"]) == 1
+        stats = _get(url + "/fleet/stats")
+        assert stats["fleet"]["replicas_active"] == 2
+        assert stats["fleet"]["requests"] == 1
+        t.join(timeout=60)
+        assert rc.get("rc") == 0
+
+    def test_sigterm_drains_fleet_and_snapshots_stats(self, tmp_path):
+        import contextlib
+        import io
+        import os
+        import re
+        import signal
+
+        from deeplearning4j_tpu.cli import main as cli_main
+
+        if threading.current_thread() is not threading.main_thread():
+            pytest.skip("SIGTERM handler needs the main thread")
+        stats_path = tmp_path / "fleet_stats.json"
+        out = io.StringIO()
+
+        def kill_when_up():
+            for _ in range(300):
+                if re.search(r"Serving fleet on http://\S+",
+                             out.getvalue()):
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    return
+                time.sleep(0.1)
+
+        t = threading.Thread(target=kill_when_up)
+        t.start()
+        with contextlib.redirect_stdout(out):
+            rc = cli_main(
+                ["serve-fleet", "-model", "zoo:iris-mlp", "-port", "0",
+                 "-replicas", "2", "-warmup", "-buckets", "1,8",
+                 "-serve-seconds", "60", "-drain-grace-s", "3",
+                 "-drain-stats", str(stats_path)])
+        t.join(timeout=30)
+        assert rc == 0
+        assert "draining fleet" in out.getvalue()
+        assert stats_path.exists()
+        snap = json.loads(stats_path.read_text())
+        assert len(snap["replicas"]) == 2
+        assert all(e["state"] == "draining" for e in snap["replicas"])
+        assert "ledger" in snap
+
+
+# ---------------------------------------------------------------------------
+# typed shape error (satellite): engine-level contract
+
+
+class TestUnservableShape:
+    def test_guard_raises_typed_subclass(self):
+        from deeplearning4j_tpu.serving import ServingEngine
+
+        net = _mlp()
+        engine = ServingEngine(net, ladder=BucketLadder((1, 8)),
+                               max_programs=1, max_wait_ms=1.0)
+        try:
+            engine.predict_proba(np.zeros((1, 4), np.float32), timeout=60)
+            with pytest.raises(UnservableShapeError,
+                               match="compile-count guard") as exc:
+                engine.predict_proba(np.zeros((2, 4), np.float32),
+                                     timeout=60)
+            # backward compatible with every historical except clause,
+            # AND a client error for the HTTP mapping
+            assert isinstance(exc.value, ServingError)
+            assert isinstance(exc.value, RuntimeError)
+            assert isinstance(exc.value, ValueError)
+        finally:
+            engine.stop()

@@ -38,6 +38,11 @@ SERVING_ALLOWLIST: dict = {
     "deeplearning4j_tpu/serving/batcher.py": 2,  # _execute bisector +
                                                  # _run survival backstop
     "deeplearning4j_tpu/serving/lm.py": 1,       # _run fail-in-flight
+    "deeplearning4j_tpu/serving/fleet.py": 1,    # _FleetHandler.do_POST
+                                                 # catch-all: the fleet
+                                                 # front must keep
+                                                 # serving (500 once,
+                                                 # typed stay 4xx/503)
 }
 SERVING_PREFIX = "deeplearning4j_tpu/serving/"
 

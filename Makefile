@@ -1,8 +1,9 @@
 # Convenience entry points. Everything here is reproducible by hand —
 # the targets just spell the one-liners out.
 
-.PHONY: test test-serving test-precision test-fleet dryrun bench smoke \
-	serving-smoke bench-precision bench-fleet evidence lint
+.PHONY: test test-serving test-precision test-fleet test-paged dryrun \
+	bench smoke serving-smoke bench-precision bench-fleet bench-paged \
+	evidence lint
 
 test:
 	python -m pytest tests/ -x -q
@@ -17,9 +18,21 @@ test-fleet:
 	python -m pytest tests/ -q -m fleet
 
 # Fleet bench row: concurrency-32 storm with a replica killed mid-storm
-# (requests/s, p99, failed must be 0).
+# (requests/s, p99, failed must be 0) + the shared-prefix LM leg.
 bench-fleet:
 	BENCH_ONLY=servingfleet python bench.py
+
+# Paged-KV tests only (block-table pool parity, radix prefix reuse +
+# copy-on-write, chunked prefill, page refcount ledger under chaos,
+# zero-recompile guard).
+test-paged:
+	python -m pytest tests/ -q -m paged
+
+# Paged-KV bench row: shared-prefix storm, paged (half-size pool) vs
+# dense — tokens/s ratio, KV bytes at equal traffic, prefix hit rate
+# (docs/performance.md "The KV memory cost model").
+bench-paged:
+	BENCH_ONLY=paged python bench.py
 
 # Broad-except linter (see docs/robustness.md): fails on new bare
 # `except Exception:` in deeplearning4j_tpu/ without a noqa pragma.
@@ -38,9 +51,10 @@ smoke:
 	BENCH_ONLY=lenet,transformer python bench.py
 
 # Serving throughput rows only (micro-batched classifier + continuous LM
-# + the overload/admission-control row + the fleet mid-storm-kill row).
+# + the overload/admission-control row + the fleet mid-storm-kill row +
+# the paged-KV shared-prefix row).
 serving-smoke:
-	BENCH_ONLY=serving,servinglm,servingoverload,servingfleet python bench.py
+	BENCH_ONLY=serving,servinglm,servingoverload,servingfleet,paged python bench.py
 
 # Precision-plane tests only (bf16-mixed parity/determinism, loss-scaler
 # overflow recovery, int8 serving agreement, dtype round-trips).

@@ -1239,6 +1239,46 @@ def bench_serving_fleet() -> dict:
     run = min(runs, key=lambda r: r["sec"])
     failed_all_legs = sum(r["failed"] for r in runs)
     ok = total - run["failed"]
+
+    # ---- fleet LM leg (ISSUE-7 satellite, ROADMAP item 5 tie-in):
+    # a shared-prefix LM storm through the router's prefix-affinity
+    # dispatch, measuring the fleet-aggregated prefix_hit_rate the
+    # affinity hashing exists to maximize (one prefix -> one replica ->
+    # one radix-cached prefill, reused by every follow-up)
+    import dataclasses
+
+    import jax
+
+    from deeplearning4j_tpu.parallel import transformer as tfm
+
+    lm_cfg = dataclasses.replace(
+        tfm.gpt2_small(max_len=64), vocab_size=256, d_model=128,
+        n_heads=4, n_layers=2, d_ff=512, dtype="float32", remat=False)
+    lm_params = tfm.init_params(lm_cfg, jax.random.PRNGKey(0))
+    lm_rng = np.random.default_rng(1)
+    lm_system = lm_rng.integers(0, lm_cfg.vocab_size, (32,)).tolist()
+    lm_n, lm_new = 12, 16
+
+    def lm_factory(name):
+        return spawn_local_replica(
+            name, lm=(lm_cfg, lm_params), lm_slots=4,
+            lm_page_size=16, lm_prefill_chunk=8)
+
+    lm_router = FleetRouter(lm_factory, replicas=2,
+                            request_timeout_s=120.0)
+    try:
+        lm_prompts = [lm_system + [int(t) for t in
+                                   lm_rng.integers(0, lm_cfg.vocab_size,
+                                                   (2,))]
+                      for _ in range(lm_n)]
+        lm_sec = _serving_storm(
+            4, lm_prompts,
+            lambda p: lm_router.generate(list(p), lm_new, timeout=120))
+        lm_stats = lm_router.fleet_stats()
+    finally:
+        lm_router.stop()
+    lm_prefix = lm_stats["fleet"].get("lm_prefix", {})
+
     return {"metric": "MLP-classifier serving fleet under a mid-storm "
                       f"replica kill (concurrency {conc}, "
                       f"{replicas} replicas)",
@@ -1255,6 +1295,16 @@ def bench_serving_fleet() -> dict:
             **_mem_fields(net=net),
             "model": "mnist-mlp 784-2048-2048-10",
             "meets_acceptance": failed_all_legs == 0,
+            "lm_prefix_storm": {
+                "replicas": 2, "requests": lm_n, "new_tokens": lm_new,
+                "shared_prefix_tokens": len(lm_system),
+                "tokens_per_sec": round(lm_n * lm_new / lm_sec, 1),
+                "prefix_hit_rate": lm_prefix.get("hit_rate"),
+                "prefix_tokens_saved": lm_prefix.get("tokens_saved"),
+                "prefix_queries": lm_prefix.get("queries"),
+                "note": "prefix-affinity routing concentrates the "
+                        "shared prefix on one replica's radix cache; "
+                        "hit rate aggregated through /fleet/stats"},
             "note": "predict is pure, so dispatches that died with the "
                     "replica were resubmitted on survivors — a replica "
                     "death costs failovers, never failed requests"}
@@ -1329,6 +1379,124 @@ def bench_serving_lm() -> dict:
             "slots": slots}
 
 
+def bench_paged_kv() -> dict:
+    """Paged-KV row (ISSUE-7 acceptance): a shared-prefix request storm
+    — every prompt opens with the same system prefix, the traffic shape
+    a prefix-affinity router concentrates on one replica — served by
+    the dense slot pool vs the paged pool (radix prefix reuse + chunked
+    prefill) provisioned with HALF the dense pool's KV bytes.
+
+    The dense leg re-prefills the shared prefix for every request, one
+    token per dispatch; the paged leg prefills it once, every later
+    request reuses the cached pages and feeds only its distinct tail
+    (chunked).  Acceptance: >= 2x tokens/s OR >= 2x effective KV
+    capacity at equal memory (the half-size pool serving the same
+    traffic is exactly that), prefix_hit_rate > 0.5, and ZERO XLA
+    compiles across the storm after warmup."""
+    import dataclasses
+
+    import jax
+    import jax.monitoring
+
+    from deeplearning4j_tpu.parallel import transformer as tfm
+    from deeplearning4j_tpu.serving import ContinuousLMServer
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = tfm.gpt2_small(max_len=256)
+        slots, n_req, new, sys_len, ps, chunk = 8, 16, 32, 128, 16, 16
+    else:
+        cfg = dataclasses.replace(
+            tfm.gpt2_small(max_len=80), vocab_size=256, d_model=128,
+            n_heads=4, n_layers=2, d_ff=512, dtype="float32", remat=False)
+        slots, n_req, new, sys_len, ps, chunk = 8, 16, 16, 48, 16, 8
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    system = rng.integers(0, cfg.vocab_size, (sys_len,)).tolist()
+    prompts = [system + rng.integers(0, cfg.vocab_size, (3,)).tolist()
+               for _ in range(n_req)]
+    conc = min(8, n_req)
+
+    def storm(srv):
+        return min(_serving_storm(
+            conc, prompts, lambda p: srv.generate(list(p), new,
+                                                  timeout=600))
+            for _ in range(2))
+
+    # ---- dense baseline (the pre-ISSUE-7 pool) ----------------------------
+    dense = ContinuousLMServer(cfg, params, slots=slots, kv="dense")
+    try:
+        dense.generate(prompts[0], new, timeout=600)     # compile
+        from deeplearning4j_tpu.serving import ServingMetrics
+
+        dense.metrics = ServingMetrics()                 # drop warmup
+        sec_dense = storm(dense)
+        dense_stats = dense.stats()
+    finally:
+        dense.stop()
+
+    # ---- paged pool at HALF the dense KV bytes ----------------------------
+    from deeplearning4j_tpu.parallel.generation import pages_per_seq
+
+    max_pages = pages_per_seq(cfg, ps)
+    half_pages = max(max_pages, slots * max_pages // 2)
+    paged = ContinuousLMServer(cfg, params, slots=slots, kv="paged",
+                               page_size=ps, pages=half_pages,
+                               prefill_chunk=chunk)
+    compiles = []
+
+    def listener(event, duration, **kw):
+        if event == "/jax/core/compile/backend_compile_duration":
+            compiles.append(event)
+
+    try:
+        paged.warmup()              # decode + chunk + CoW compiled here
+        jax.monitoring.register_event_duration_secs_listener(listener)
+        try:
+            sec_paged = storm(paged)
+        finally:
+            jax.monitoring.clear_event_listeners()
+        paged_stats = paged.stats()
+    finally:
+        paged.stop()
+
+    toks = n_req * new
+    speedup = round(sec_dense / sec_paged, 2)
+    kv_ratio = round(dense_stats["kv_bytes"]["provisioned"]
+                     / paged_stats["kv_bytes"]["provisioned"], 2)
+    hit_rate = paged_stats.get("prefix_hit_rate", 0.0)
+    lat = paged_stats.get("latency", {})
+    return {"metric": "TransformerLM paged-KV serving tokens/sec "
+                      f"(shared {sys_len}-token prefix storm, "
+                      f"{slots} slots, half-size pool)",
+            "unit": "tokens/sec", "value": round(toks / sec_paged, 1),
+            "requests": n_req, "new_tokens": new,
+            "prompt_len": sys_len + 3, "shared_prefix_tokens": sys_len,
+            "page_size": ps, "pages": half_pages,
+            "prefill_chunk": chunk,
+            **_mem_fields(params=params),
+            "dense_tokens_per_sec": round(toks / sec_dense, 1),
+            "paged_vs_dense": speedup,
+            "kv_bytes_dense": dense_stats["kv_bytes"]["provisioned"],
+            "kv_bytes_paged": paged_stats["kv_bytes"]["provisioned"],
+            "kv_capacity_vs_dense_at_equal_traffic": kv_ratio,
+            "prefix_hit_rate": hit_rate,
+            "prefix_tokens_saved":
+                paged_stats.get("prefix_tokens_saved", 0),
+            "dense_decode_steps": dense_stats["decode_steps"],
+            "paged_decode_steps": paged_stats["decode_steps"],
+            "p50_ms": lat.get("p50_ms"), "p99_ms": lat.get("p99_ms"),
+            "compiled_programs": paged_stats["compiled_programs"],
+            "off_ladder_compiles": len(compiles),
+            "meets_acceptance": bool(
+                (speedup >= 2.0 or (kv_ratio >= 2.0 and speedup >= 1.2))
+                and (hit_rate or 0) > 0.5 and not compiles),
+            "note": "paged pool holds HALF the dense pool's KV bytes "
+                    "and serves the same storm: the capacity ratio is "
+                    "measured at equal traffic, the tokens/s ratio on "
+                    "top of it"}
+
+
 def _flash_fallback(row_fn):
     """Run a transformer row; if it dies on TPU with the Pallas flash
     path enabled (e.g. a Mosaic lowering rejection the CPU interpreter
@@ -1373,6 +1541,7 @@ BENCHES = {
     "servinglm": bench_serving_lm,
     "servingoverload": bench_serving_overload,
     "servingfleet": bench_serving_fleet,
+    "paged": bench_paged_kv,
     "precision": bench_precision,
     "flashab": bench_flash_ab,
     "longctx": bench_longctx,

@@ -431,9 +431,28 @@ def cmd_serve(args) -> int:
         srv.serve_lm(cfg, params, slots=args.lm_slots,
                      max_queue_depth=max_queue,
                      default_deadline_s=deadline_s,
-                     breaker_threshold=breaker_n)
-        print(f"serve: LM registered ({cfg.n_layers}L/d{cfg.d_model}, "
-              f"max_len {cfg.max_len}, {args.lm_slots} decode slots)")
+                     breaker_threshold=breaker_n,
+                     kv=args.lm_kv, page_size=args.page_size,
+                     pages=(args.lm_pages if args.lm_pages > 0 else None),
+                     prefill_chunk=args.prefill_chunk)
+        lm_srv = srv.state.lm_server
+        # -warmup opts the LM pool into pre-traffic compiles too, same
+        # contract as the classifier path: without it each program
+        # compiles on its first dispatch
+        warmed = (lm_srv.warmup() if lm_srv is not None and args.warmup
+                  else 0)
+        warm_note = (f"{warmed} programs warm" if warmed
+                     else "programs compile on first use")
+        if lm_srv is not None and args.lm_kv == "paged":
+            print(f"serve: LM registered ({cfg.n_layers}L/d{cfg.d_model}, "
+                  f"max_len {cfg.max_len}, {args.lm_slots} decode slots, "
+                  f"paged KV: {lm_srv.kv_pages} pages x "
+                  f"{lm_srv.page_size} tokens, prefill chunk "
+                  f"{lm_srv.prefill_chunk}, {warm_note})")
+        else:
+            print(f"serve: LM registered ({cfg.n_layers}L/d{cfg.d_model}, "
+                  f"max_len {cfg.max_len}, {args.lm_slots} decode slots, "
+                  f"dense KV, {warm_note})")
     srv.start()
     print(f"serve: resilience max_queue={max_queue or 'unbounded'} "
           f"deadline_ms={args.deadline_ms or 'none'} "
@@ -1027,6 +1046,26 @@ def build_parser() -> argparse.ArgumentParser:
     p_serve.add_argument("-lm-slots", "--lm-slots", dest="lm_slots",
                          type=int, default=4,
                          help="continuous-decode lanes for /lm/generate")
+    p_serve.add_argument("-lm-kv", "--lm-kv", dest="lm_kv",
+                         choices=("paged", "dense"), default="paged",
+                         help="KV cache mode for the continuous pool: "
+                              "block-table paged with radix prefix "
+                              "reuse (default) or the dense per-slot "
+                              "cache (docs/performance.md)")
+    p_serve.add_argument("-lm-pages", "--lm-pages", dest="lm_pages",
+                         type=int, default=0,
+                         help="KV pages in the paged pool (0 = full "
+                              "worst-case capacity, slots * "
+                              "ceil(max_len/page_size)); smaller pools "
+                              "trade admission waits for memory")
+    p_serve.add_argument("-page-size", "--page-size", dest="page_size",
+                         type=int, default=16,
+                         help="tokens per KV page (prefix sharing is "
+                              "page-granular)")
+    p_serve.add_argument("-prefill-chunk", "--prefill-chunk",
+                         dest="prefill_chunk", type=int, default=8,
+                         help="max prompt tokens fed per dispatch "
+                              "during prefill (1 = token-at-a-time)")
     p_serve.add_argument("-serve-seconds", "--serve-seconds",
                          dest="serve_seconds", type=float, default=0,
                          help="stop after this many seconds (0 = run "

@@ -303,6 +303,176 @@ def make_slot_step(cfg: TransformerConfig):
 
 
 # ---------------------------------------------------------------------------
+# Paged slot decode (block-table paged KV for the continuous LM pool)
+#
+# The dense slot cache above provisions `slots * max_len` KV positions
+# whether or not any lane ever fills them — the serving-state memory
+# ceiling.  The paged variant replaces it with ONE fixed pool of
+# `[pages, page_size, H, K]` pages per layer plus a per-slot page list
+# (`[slots, max_pages]` int32 block table) carried through the jitted
+# step: a lane's logical position `t` lives at
+# `pool[table[slot, t // page_size], t % page_size]`, so device capacity
+# is sum-of-actual-lengths, pages are refcount-shared between lanes with
+# a common prompt prefix (radix cache, `serving/paged.py`), and a prompt
+# can feed up to `chunk` tokens per dispatch (chunked prefill) without a
+# shape change.  Page 0 is the reserved NULL page: masked lanes and
+# padding columns write there, and unallocated block-table entries point
+# there — its contents are garbage by design and every read of it is
+# masked.  One jitted program per (config, pages, page_size, chunk).
+
+
+def pages_per_seq(cfg: TransformerConfig, page_size: int) -> int:
+    """Block-table width: logical pages needed for one max_len lane."""
+    return -(-int(cfg.max_len) // int(page_size))
+
+
+def init_paged_cache(cfg: TransformerConfig, pages: int,
+                     page_size: int) -> dict:
+    """Paged KV pool: `pages` pages of `page_size` positions per layer
+    (page 0 reserved as the null page)."""
+    dt = jnp.dtype(cfg.dtype)
+    shape = (int(pages), int(page_size), cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros((cfg.n_layers,) + shape, dt),
+            "v": jnp.zeros((cfg.n_layers,) + shape, dt)}
+
+
+def _paged_attn(p, x, layer_k, layer_v, table, pos, n_feed):
+    """Block-table paged attention for one layer.
+
+    x: [B, C, d] (C = prefill chunk width; decode dispatches use C=1);
+    layer_k/v: [P, ps, H, K] page pool; table: [B, MP] int32 page ids;
+    pos: [B] start positions; n_feed: [B] real columns this dispatch.
+
+    Each lane scatters its fed tokens' k/v into its OWN pages (padding
+    columns and inactive lanes write the null page 0), then gathers its
+    logical history through the block table and runs exactly the dense
+    `_slot_attn` math over it — masked positions contribute exact zeros,
+    so outputs are byte-identical to the dense pool."""
+    q, k, v = qkv_proj(p, x)                              # [B, C, H, K]
+    b, c, h, kd = q.shape
+    pages, ps = layer_k.shape[0], layer_k.shape[1]
+    mp = table.shape[1]
+    j = jnp.arange(c)[None, :]                            # [1, C]
+    wpos = pos[:, None] + j                               # [B, C] write pos
+    real = j < n_feed[:, None]                            # [B, C]
+    lpage = jnp.minimum(wpos // ps, mp - 1)               # logical page
+    page = jnp.take_along_axis(table, lpage, axis=1)      # physical page
+    page = jnp.where(real, page, 0)                       # padding -> null
+    off = jnp.where(real, wpos % ps, 0)
+    idx = (page * ps + off).reshape(-1)                   # [B*C] flat rows
+    fk = layer_k.reshape(pages * ps, h, kd).at[idx].set(
+        k.reshape(b * c, h, kd))
+    fv = layer_v.reshape(pages * ps, h, kd).at[idx].set(
+        v.reshape(b * c, h, kd))
+    # gather each lane's logical history: [B, S, H, K], S = MP * ps
+    gidx = (table[:, :, None] * ps
+            + jnp.arange(ps)[None, None, :]).reshape(b, mp * ps)
+    hk, hv = fk[gidx], fv[gidx]
+    s = jnp.einsum("bqhk,bshk->bqhs", q, hk) / jnp.sqrt(
+        jnp.asarray(kd, q.dtype))
+    causal = jnp.arange(mp * ps)[None, None, :] <= wpos[:, :, None]
+    s = jnp.where(causal[:, :, None, :], s, -1e30)      # [B, C, H, S]
+    w = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bqhs,bshk->bqhk", w, hv)
+    return (out_proj(p, o), fk.reshape(pages, ps, h, kd),
+            fv.reshape(pages, ps, h, kd))
+
+
+def paged_decode_step(cfg: TransformerConfig, params: dict, cache: dict,
+                      table: jax.Array, pos: jax.Array, n_feed: jax.Array,
+                      tokens: jax.Array) -> Tuple[jax.Array, dict]:
+    """tokens: [B, C] int32, lane b feeding its first n_feed[b] columns
+    at positions pos[b].. -> (logits [B, V] at each lane's LAST fed
+    column, cache with the fed k/v scattered into the page pool).
+
+    Identical math to `slot_decode_step` per position — the chunk's own
+    writes land in the pool before the gather, so intra-chunk causal
+    attention rides the same masked-softmax path as the history."""
+    c = tokens.shape[1]
+    wpos = pos[:, None] + jnp.arange(c)[None, :]
+    pidx = jnp.minimum(wpos, cfg.max_len - 1)             # clip padding
+    x = params["embed"][tokens] + params["pos"][pidx]     # [B, C, d]
+    ks, vs = [], []
+    for i, layer in enumerate(params["layers"]):
+        a, nk, nv = _paged_attn(layer["attn"],
+                                _layer_norm(layer["ln1"], x),
+                                cache["k"][i], cache["v"][i],
+                                table, pos, n_feed)
+        ks.append(nk)
+        vs.append(nv)
+        x = x + a
+        hh = _layer_norm(layer["ln2"], x)
+        x = x + (_moe(layer["moe"], hh, top_k=cfg.moe_top_k)
+                 if "moe" in layer else _mlp(layer["mlp"], hh))
+    x = _layer_norm(params["ln_f"], x)
+    logits = jnp.einsum("bcd,dv->bcv", x, lm_head(params))
+    last = jnp.take_along_axis(
+        logits, jnp.maximum(n_feed - 1, 0)[:, None, None], axis=1)[:, 0]
+    return last, {"k": jnp.stack(ks), "v": jnp.stack(vs)}
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_paged_step(cfg: TransformerConfig, pages: int,
+                         page_size: int, chunk: int):
+    """One jitted paged program per (config, pages, page_size, chunk):
+    the pool shape and block-table width are baked in, the k/v buffers
+    are donated, and sampling is the SAME device-side per-slot automaton
+    as `_compiled_slot_step` (greedy/temperature, fold_in(seed, count))
+    so paged and dense lanes sample byte-identically."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def step(params, cache_k, cache_v, table, pos, n_feed, tokens,
+             temperature, seeds, counts):
+        cache = {"k": cache_k, "v": cache_v}
+        logits, cache = paged_decode_step(cfg, params, cache, table, pos,
+                                          n_feed, tokens)
+        logits = logits.astype(jnp.float32)
+        greedy = jnp.argmax(logits, axis=-1)
+        keys = jax.vmap(lambda s, c: jax.random.fold_in(
+            jax.random.PRNGKey(s), c))(seeds, counts)
+        temp = jnp.maximum(temperature, 1e-6)[:, None]
+        sampled = jax.vmap(jax.random.categorical)(keys, logits / temp)
+        nxt = jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+        return nxt, cache["k"], cache["v"]
+
+    return step
+
+
+def make_paged_step(cfg: TransformerConfig, pages: int, page_size: int,
+                    chunk: int):
+    """Compiled paged-step entry for `serving.lm.ContinuousLMServer`:
+    fn(params, k, v, table [B, MP], pos [B], n_feed [B], tokens [B, C],
+    temperature [B], seeds [B], counts [B]) -> (next_token [B], k, v)."""
+    return _compiled_paged_step(cfg, int(pages), int(page_size),
+                                int(chunk))
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_page_copy(cfg: TransformerConfig, pages: int,
+                        page_size: int):
+    """Copy-on-write primitive: duplicate ONE page (all layers, k and v)
+    inside the donated pool.  Host-side admission calls this once per
+    divergence page — a request whose prompt shares a cached prefix that
+    ends mid-page copies that page and overwrites from the divergence
+    offset, instead of re-prefilling the whole page."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def copy(cache_k, cache_v, src, dst):
+        def dup(buf):
+            page = lax.dynamic_slice_in_dim(buf, src, 1, axis=1)
+            return lax.dynamic_update_slice_in_dim(buf, page, dst, axis=1)
+
+        return dup(cache_k), dup(cache_v)
+
+    return copy
+
+
+def make_page_copy(cfg: TransformerConfig, pages: int, page_size: int):
+    """Compiled page-copy entry: fn(k, v, src, dst) -> (k, v)."""
+    return _compiled_page_copy(cfg, int(pages), int(page_size))
+
+
+# ---------------------------------------------------------------------------
 # Beam search (extension: the reference has no generative inference at all)
 
 @functools.lru_cache(maxsize=16)

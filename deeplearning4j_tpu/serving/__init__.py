@@ -11,9 +11,15 @@ coalescing K concurrent *requests* per device dispatch.
   pattern compiles a bounded, pre-warmable program set (`bucketing.py`);
 - `ServingEngine` — a MultiLayerNetwork behind batcher + ladder with an
   explicit `warmup()` and a compile-count guard (`engine.py`);
-- `ContinuousLMServer` — slot-based continuous LM decode over one fixed
-  `[slots, max_len]` KV cache: finished sequences free their slot and
-  queued prompts join mid-flight (`lm.py`);
+- `ContinuousLMServer` — slot-based continuous LM decode: finished
+  sequences free their slot and queued prompts join mid-flight
+  (`lm.py`).  KV state is block-table PAGED by default (ISSUE-7):
+  a fixed pool of `[pages, page_size]` KV pages addressed through
+  per-slot page lists, pages allocated on admission and refcount-freed
+  on completion (`PagePool`), shared prompt prefixes prefilled once and
+  radix-cached (`RadixPrefixCache`, copy-on-write at the divergence
+  page), long prompts fed up to `prefill_chunk` tokens per dispatch;
+  `kv="dense"` keeps the original `[slots, max_len]` cache;
 - `ServingMetrics` — queue depth, batch occupancy, p50/p95/p99 latency,
   requests/s and tokens/s, plus the resilience ledger (`rejected`,
   `shed`, `deadline_missed`, `poison_isolated`, `breaker_state`)
@@ -54,6 +60,11 @@ from deeplearning4j_tpu.serving.fleet import (
 )
 from deeplearning4j_tpu.serving.lm import ContinuousLMServer
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.paged import (
+    PageLeakError,
+    PagePool,
+    RadixPrefixCache,
+)
 from deeplearning4j_tpu.serving.resilience import (
     CircuitBreaker,
     CircuitOpenError,
@@ -75,6 +86,9 @@ __all__ = [
     "FleetRouter",
     "FleetServer",
     "MicroBatcher",
+    "PageLeakError",
+    "PagePool",
+    "RadixPrefixCache",
     "Replica",
     "ServingEngine",
     "ServingError",

@@ -226,6 +226,9 @@ def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
                         breaker_threshold: Optional[int] = 5,
                         breaker_cooldown_s: float = 1.0,
                         quantize: Optional[str] = None,
+                        lm_kv: str = "paged", lm_page_size: int = 16,
+                        lm_pages: Optional[int] = None,
+                        lm_prefill_chunk: int = 8,
                         version: int = 0) -> Replica:
     """Thread-hosted replica: an in-process `UiServer` on a free port
     with its own engine surface (`/model/predict`, `/lm/generate`,
@@ -255,7 +258,13 @@ def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
                      max_queue_depth=max_queue_depth,
                      default_deadline_s=default_deadline_s,
                      breaker_threshold=breaker_threshold,
-                     breaker_cooldown_s=breaker_cooldown_s)
+                     breaker_cooldown_s=breaker_cooldown_s,
+                     kv=lm_kv, page_size=lm_page_size, pages=lm_pages,
+                     prefill_chunk=lm_prefill_chunk)
+        # warm the paged programs BEFORE the replica enters rotation —
+        # same zero-compile-on-the-request-path rule as warmup_example
+        if srv.state.lm_server is not None:
+            srv.state.lm_server.warmup()
     srv.start()
     return Replica(name, srv.url, server=srv, version=version)
 
@@ -826,6 +835,21 @@ class FleetRouter:
         fleet["replicas_routable"] = sum(
             1 for r in replicas if r.routable())
         fleet.update(counters)
+        # fleet-level LM prefix-reuse view (ISSUE-7): the router's
+        # prefix-affinity hashing exists to concentrate shared prompts
+        # per replica — this is the number that says whether it worked
+        prefix = {"queries": 0, "hits": 0, "tokens_saved": 0}
+        for payload in stats_by_name.values():
+            lm = (payload or {}).get("lm") or {}
+            if lm.get("prefix_queries"):
+                prefix["queries"] += int(lm["prefix_queries"])
+                prefix["hits"] += int(lm.get("prefix_hits") or 0)
+                prefix["tokens_saved"] += int(
+                    lm.get("prefix_tokens_saved") or 0)
+        if prefix["queries"]:
+            prefix["hit_rate"] = round(
+                prefix["hits"] / prefix["queries"], 3)
+            fleet["lm_prefix"] = prefix
         out = {"fleet": fleet, "replicas": entries, "retired": retired}
         if include_replica_stats:
             out["ledger"] = check_fleet_ledger(out)

@@ -3,16 +3,43 @@
 `generate()` decodes one request (or one fixed batch) to completion:
 requests arriving mid-decode wait for the whole previous decode.  The
 continuous server instead keeps a fixed pool of `slots` decode lanes
-over ONE `[L, slots, max_len, H, K]` KV cache and advances every active
-lane one token per device step (`parallel.generation.make_slot_step`):
+and advances every active lane each device step:
 
 - a finished sequence frees its slot immediately;
-- a queued prompt joins mid-flight — its slot restarts at position 0 and
-  its prompt tokens are teacher-forced through the same per-token step
-  (prefill-as-decode), so admission never interrupts other lanes;
+- a queued prompt joins mid-flight — prefill rides the same per-token
+  step (prefill-as-decode), so admission never interrupts other lanes;
 - every dispatch shape is fixed (`slots` lanes, whatever is inactive
-  rides as masked padding), so the WHOLE serving lifetime runs ONE
-  compiled program per config.
+  rides as masked padding), so the WHOLE serving lifetime runs a fixed,
+  pre-compilable program set per config.
+
+KV state comes in two modes (ISSUE-7):
+
+- `kv="dense"` — the original one `[L, slots, max_len, H, K]` cache:
+  every lane provisions max_len positions whether it uses them or not
+  (`parallel.generation.make_slot_step`).
+- `kv="paged"` (default) — block-table paged KV: one fixed pool of
+  `[pages, page_size, H, K]` pages per layer, per-slot page lists
+  carried as a `[slots, max_pages]` int32 block table inside the jitted
+  step (`parallel.generation.make_paged_step`).  Pages are allocated on
+  admission and refcount-freed on completion (`serving/paged.py`), so
+  device capacity is sum-of-actual-lengths instead of slots * max_len.
+  On top of it:
+
+  * **radix prefix reuse** — a host-side radix tree over prompt token
+    prefixes maps to refcounted page runs; a request whose prompt
+    shares a cached prefix skips prefill for those tokens entirely
+    (copy-on-write at the divergence page), which is what the fleet's
+    prefix-affinity router (ISSUE-6) was set up to feed;
+  * **chunked prefill** — a long prompt feeds up to `prefill_chunk`
+    tokens per dispatch instead of one, so admission latency shrinks
+    by ~chunk× while active decode lanes keep advancing every step.
+
+  The compile-count discipline holds: one program per
+  (config, pages, page_size, chunk) — a decode-step (chunk 1), one
+  prefill-chunk step when `prefill_chunk > 1`, and the copy-on-write
+  page copy; `warmup()` compiles all of them before traffic (after it,
+  no request can trigger an XLA compile), otherwise each compiles on
+  its first dispatch like every other serving program.
 
 Greedy and plain-temperature sampling run in the slot pool (sampling is
 seeded per request: `fold_in(PRNGKey(seed), tokens_generated)`, so a
@@ -24,10 +51,14 @@ switches.
 Resilience contract (ISSUE-4, mirrors `batcher.MicroBatcher`): bounded
 admission (`max_queue_depth` -> `ServingOverloadError`), per-request
 deadlines shed at the admitter before a prompt ever occupies a slot
-(`DeadlineExceededError`), an abandoned request's slot is freed so a
-timed-out client stops costing decode steps, an optional circuit
-breaker fast-fails admission after consecutive step failures, and
-`begin_drain()`/`drain()` implement the SIGTERM grace window.
+(`DeadlineExceededError`), an abandoned request's slot (and its pages)
+is freed so a timed-out client stops costing decode steps, an optional
+circuit breaker fast-fails admission after consecutive step failures,
+and `begin_drain()`/`drain()` implement the SIGTERM grace window.  A
+failed dispatch consumed its donated KV buffers AND invalidated the
+page contents, so the recovery path rebuilds the device pool and resets
+the allocator + radix tree together — a stale tree entry pointing into
+a zeroed pool would serve silent garbage.
 """
 
 from __future__ import annotations
@@ -40,6 +71,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.paged import PagePool, RadixPrefixCache
 from deeplearning4j_tpu.serving.resilience import (
     CircuitBreaker,
     CircuitOpenError,
@@ -92,13 +124,19 @@ class _LMRequest:
 
 
 class _Slot:
-    __slots__ = ("req", "pos", "fed", "generated")
+    __slots__ = ("req", "pos", "fed", "generated",
+                 "table", "owned", "shared", "inserted")
 
     def __init__(self):
         self.req: Optional[_LMRequest] = None
         self.pos = 0          # next cache position to write
         self.fed = 0          # prompt tokens already fed (prefill cursor)
         self.generated: List[int] = []
+        # paged-KV bookkeeping (kv="paged" only)
+        self.table: Optional[np.ndarray] = None   # [max_pages] int32 row
+        self.owned: List[int] = []    # pages this lane allocated
+        self.shared: List[int] = []   # prefix pages reused from the tree
+        self.inserted = False         # prompt pages registered in the tree
 
     @property
     def active(self) -> bool:
@@ -110,25 +148,51 @@ class ContinuousLMServer:
 
     `generate(prompt_ids, max_new_tokens)` is thread-safe and blocks
     until the request's sequence is complete; any number of requests
-    share the device via the slot pool.
+    share the device via the slot pool.  `kv="paged"` (default) serves
+    from the block-table paged pool with radix prefix reuse and chunked
+    prefill; `kv="dense"` keeps the original per-slot dense cache (the
+    bench baseline).
     """
 
     def __init__(self, cfg, params, slots: int = 4,
                  metrics: Optional[ServingMetrics] = None,
                  max_queue_depth: Optional[int] = None,
                  default_deadline_s: Optional[float] = None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 kv: str = "paged", page_size: int = 16,
+                 pages: Optional[int] = None, prefill_chunk: int = 8):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if max_queue_depth is not None and max_queue_depth < 1:
             raise ValueError(f"max_queue_depth must be >= 1 or None, got "
                              f"{max_queue_depth}")
+        if kv not in ("paged", "dense"):
+            raise ValueError(f"kv must be 'paged' or 'dense', got {kv!r}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
         self.cfg = cfg
         self.params = params
         self.n_slots = int(slots)
         self.max_queue_depth = max_queue_depth
         self.default_deadline_s = default_deadline_s
         self.breaker = breaker
+        self.kv = kv
+        self.page_size = int(page_size)
+        from deeplearning4j_tpu.parallel.generation import pages_per_seq
+
+        self.max_pages = pages_per_seq(cfg, self.page_size)
+        # `pages` = usable KV pages in the pool (the reserved null page
+        # is on top).  Default: full worst-case capacity — every slot
+        # can hold max_len, and prefix sharing turns into extra
+        # effective capacity rather than a correctness question.
+        self.kv_pages = (int(pages) if pages is not None
+                         else self.n_slots * self.max_pages)
+        if self.kv_pages < 1:
+            raise ValueError(f"pages must be >= 1, got {self.kv_pages}")
+        self.prefill_chunk = int(prefill_chunk)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         if breaker is not None:
             breaker.add_listener(self.metrics.set_breaker_state)
@@ -139,15 +203,38 @@ class ContinuousLMServer:
         self._accepting = True
         self._thread: Optional[threading.Thread] = None
         self._cache = None    # lazy: (k, v) device buffers
-        self._step = None
+        self._step = None     # ONE dispatch entry point (tests stub it)
+        self._decode_step = None
+        self._chunk_step = None
+        self._copy = None
+        self._pool: Optional[PagePool] = None
+        self._tree: Optional[RadixPrefixCache] = None
+        self._pending_cow: List[Dict] = []
+        self._warm_req: Optional[threading.Event] = None
         self._slots = [_Slot() for _ in range(self.n_slots)]
         self._steps = 0
 
     # ---- client side ------------------------------------------------------
 
+    def _required_pages(self, plen: int, max_new: int) -> int:
+        """Pages one lane needs: positions written = plen + max_new - 1
+        (the final sampled token is returned, never fed)."""
+        return -(-(plen + max_new - 1) // self.page_size)
+
     def validate(self, prompt_ids, max_new_tokens: int) -> List[int]:
-        """`validate_request` against this server's config."""
-        return validate_request(self.cfg, prompt_ids, max_new_tokens)
+        """`validate_request` against this server's config, plus the
+        paged pool's hard capacity: a request that could never fit the
+        whole pool is the client's error, not an overload."""
+        ids = validate_request(self.cfg, prompt_ids, max_new_tokens)
+        if self.kv == "paged":
+            need = self._required_pages(len(ids), int(max_new_tokens))
+            if need > self.kv_pages:
+                raise ValueError(
+                    f"request needs {need} KV pages "
+                    f"({len(ids)} prompt + {int(max_new_tokens)} new, "
+                    f"page_size {self.page_size}) but the pool holds "
+                    f"{self.kv_pages}; raise -lm-pages or shorten it")
+        return ids
 
     def _retry_after_locked(self) -> float:
         lat = self.metrics.latency.summary()
@@ -222,6 +309,67 @@ class ContinuousLMServer:
             raise req.error
         return req.result
 
+    def warmup(self, timeout: Optional[float] = 600.0) -> int:
+        """Start the worker and pre-compile every device program before
+        traffic; returns the compiled-program count.  Without warmup
+        each program compiles on its first dispatch (the decode step on
+        the first request, the prefill-chunk step on the first
+        full-chunk prompt, the CoW copy on the first mid-page prefix
+        split) — the same lazy-until-warmup contract as
+        `ServingEngine.warmup()`: after warmup, NO request can trigger
+        an XLA compile, which is what the zero-recompile storm tests
+        pin via jax.monitoring.
+
+        The warm dispatches run on the WORKER's live cache (inactive
+        lanes write only the reserved null page), not a throwaway copy:
+        a pool sized to fill device memory must not transiently double
+        during startup or a rolling swap."""
+        with self._cond:
+            if not self._running:
+                self._start_locked()
+            ev = self._warm_req
+            if ev is None:
+                ev = self._warm_req = threading.Event()
+            self._cond.notify_all()
+        if not ev.wait(timeout):
+            # the warm never ran (dense mode never went idle, or the
+            # device is wedged): report 0, not a count the zero-compile
+            # contract would falsely promise
+            return 0
+        return self.compiled_programs()
+
+    def _warm_programs(self) -> None:
+        """Worker-side warm: one dispatch per program against the live
+        cache.  Only called while every lane is idle — the paged step
+        with n_feed=0 writes nothing but the null page, and the idle
+        dense step's pos-0 write lands in lanes that restart at pos 0
+        on admission anyway — so cache contents stay serviceable and no
+        second pool is ever allocated."""
+        if self._cache is None:
+            self._reset_cache()
+        zi = np.zeros((self.n_slots,), np.int32)
+        zf = np.zeros((self.n_slots,), np.float32)
+        if self.kv == "dense":
+            _, k, v = self._step(self.params, *self._cache, zi, zi, zf,
+                                 zi, zi)
+            self._cache = (k, v)
+            return
+        table = np.zeros((self.n_slots, self.max_pages), np.int32)
+        widths = [1] + ([self.prefill_chunk]
+                        if self.prefill_chunk > 1 else [])
+        for w in widths:
+            tok = np.zeros((self.n_slots, w), np.int32)
+            _, k, v = self._step(self.params, *self._cache, table, zi,
+                                 zi, tok, zf, zi, zi)
+            self._cache = (k, v)
+        k, v = self._copy(*self._cache, np.int32(0), np.int32(0))
+        self._cache = (k, v)
+
+    def compiled_programs(self) -> int:
+        if self.kv == "dense":
+            return 1
+        return 2 + (1 if self.prefill_chunk > 1 else 0)
+
     def stop(self) -> None:
         with self._cond:
             self._running = False
@@ -281,6 +429,24 @@ class ContinuousLMServer:
         self.stop()
         return drained
 
+    def _kv_bytes(self) -> Dict:
+        """Actual vs provisioned KV bytes — the honest memory column for
+        the bench (a dense pool's provisioned bytes are paid whether or
+        not any lane fills them; the paged pool's actual bytes follow
+        the refcounted pages, radix-shared prefixes counted once)."""
+        cfg = self.cfg
+        per_tok = (2 * cfg.n_layers * cfg.n_heads * cfg.head_dim
+                   * np.dtype(cfg.dtype).itemsize)
+        if self.kv == "dense":
+            provisioned = self.n_slots * cfg.max_len * per_tok
+            active = per_tok * sum(s.pos for s in self._slots if s.active)
+        else:
+            provisioned = self.kv_pages * self.page_size * per_tok
+            in_use = self._pool.in_use if self._pool is not None else 0
+            active = in_use * self.page_size * per_tok
+        return {"provisioned": int(provisioned), "active": int(active),
+                "per_token": int(per_tok)}
+
     def stats(self) -> Dict:
         out = self.metrics.snapshot()
         with self._cond:
@@ -289,48 +455,196 @@ class ContinuousLMServer:
             out["queue_depth"] = len(self._queue)
             out["decode_steps"] = self._steps
             out["accepting"] = self._accepting
+            out["kv_bytes"] = self._kv_bytes()
+            kv = {"mode": self.kv}
+            if self.kv == "paged":
+                kv.update({
+                    "page_size": self.page_size,
+                    "pages": self.kv_pages,
+                    "max_pages_per_seq": self.max_pages,
+                    "prefill_chunk": self.prefill_chunk,
+                    "pages_in_use": (self._pool.in_use
+                                     if self._pool is not None else 0),
+                    "pages_free": (self._pool.free
+                                   if self._pool is not None
+                                   else self.kv_pages),
+                    "radix_nodes": (self._tree.nodes
+                                    if self._tree is not None else 0)})
+            out["kv"] = kv
         out["max_len"] = self.cfg.max_len
-        out["compiled_programs"] = 1  # one slot program per config
+        out["compiled_programs"] = self.compiled_programs()
         return out
 
     # ---- worker side ------------------------------------------------------
 
     def _reset_cache(self) -> None:
-        """(Re)allocate the KV pool.  Needed after a FAILED dispatch
-        too: the step donates the k/v buffers, so an exception mid-step
-        leaves `self._cache` pointing at deleted buffers — without a
-        rebuild the keep-serving path would fail every later request."""
-        from deeplearning4j_tpu.parallel.generation import init_slot_cache
+        """(Re)allocate the device KV buffers.  Needed after a FAILED
+        dispatch too: the step donates the k/v buffers, so an exception
+        mid-step leaves `self._cache` pointing at deleted buffers —
+        without a rebuild the keep-serving path would fail every later
+        request.  Host-side page state is reset separately
+        (`_reset_pool`) because it must happen BEFORE the next admit
+        round, while the device rebuild may be deferred to dispatch."""
+        if self.kv == "dense":
+            from deeplearning4j_tpu.parallel.generation import (
+                init_slot_cache,
+            )
 
-        cache = init_slot_cache(self.cfg, self.n_slots)
+            cache = init_slot_cache(self.cfg, self.n_slots)
+        else:
+            from deeplearning4j_tpu.parallel.generation import (
+                init_paged_cache,
+            )
+
+            cache = init_paged_cache(self.cfg, self.kv_pages + 1,
+                                     self.page_size)
         self._cache = (cache["k"], cache["v"])
+
+    def _reset_pool(self) -> None:
+        """Fresh allocator + radix tree + slot page bookkeeping.  Called
+        at start and whenever the device pool's CONTENTS died (failed
+        dispatch, worker stop): a radix entry pointing into a rebuilt
+        pool would serve zeros as a cached prefix."""
+        if self.kv != "paged":
+            return
+        self._pool = PagePool(self.kv_pages + 1, self.page_size)
+        self._tree = RadixPrefixCache(self._pool)
+        self._pending_cow = []
+        for s in self._slots:
+            s.table = None
+            s.owned = []
+            s.shared = []
+            s.inserted = False
+        self.metrics.set_pages(0, self.kv_pages, self.kv_pages)
 
     def _start_locked(self) -> None:
         if self._step is None:
-            from deeplearning4j_tpu.parallel.generation import (
-                make_slot_step,
-            )
+            if self.kv == "dense":
+                from deeplearning4j_tpu.parallel.generation import (
+                    make_slot_step,
+                )
 
-            self._step = make_slot_step(self.cfg)
+                self._step = make_slot_step(self.cfg)
+            else:
+                from deeplearning4j_tpu.parallel.generation import (
+                    make_page_copy,
+                    make_paged_step,
+                )
+
+                total = self.kv_pages + 1
+                self._decode_step = make_paged_step(
+                    self.cfg, total, self.page_size, 1)
+                self._chunk_step = (make_paged_step(
+                    self.cfg, total, self.page_size, self.prefill_chunk)
+                    if self.prefill_chunk > 1 else None)
+                self._copy = make_page_copy(self.cfg, total,
+                                            self.page_size)
+
+                def dispatch(params, k, v, table, pos, n_feed, tokens,
+                             temperature, seeds, counts):
+                    # ONE entry point for every paged dispatch (decode
+                    # and prefill-chunk widths) so fault-injection tests
+                    # that stub `self._step` intercept them all
+                    fn = (self._decode_step if tokens.shape[1] == 1
+                          else self._chunk_step)
+                    return fn(params, k, v, table, pos, n_feed, tokens,
+                              temperature, seeds, counts)
+
+                self._step = dispatch
+            self._reset_pool()
             self._reset_cache()
         self._running = True
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="lm-decode")
         self._thread.start()
 
+    # ---- paged admission --------------------------------------------------
+
+    def _free_slot_pages(self, slot: _Slot) -> None:
+        """Refcount-release everything a lane held: its own pages drop
+        to 0 and return to the free list unless the radix tree kept
+        them; shared prefix pages drop back to their other holders."""
+        if self.kv != "paged" or self._pool is None:
+            return
+        if slot.owned:
+            self._pool.release(slot.owned)
+        if slot.shared:
+            self._pool.release(slot.shared)
+        slot.owned = []
+        slot.shared = []
+        slot.table = None
+        slot.inserted = False
+
+    def _plan_admission_paged(self, req: _LMRequest):
+        """Radix-match + allocate for one queued request.  Returns the
+        install plan, or None when the pool (after eviction) cannot
+        supply the fresh pages — the request stays queued, FIFO.  Every
+        page the plan references is already retained."""
+        plen = len(req.prompt)
+        total_pages = self._required_pages(plen, req.max_new)
+        # cap reuse at plen-1: the LAST prompt token is always re-fed —
+        # its logits are what the first sampled token comes from
+        full, partial = self._tree.match(req.prompt[:plen - 1])
+        if len(full) > total_pages:     # cannot happen (cap above), but
+            raise AssertionError("radix match exceeded the page budget")
+        need = total_pages - len(full)
+        if self._pool.free < need:
+            # evict ONLY when eviction can actually cover the shortfall:
+            # wiping cached prefixes while still admitting nothing would
+            # destroy the hit rate for zero capacity gained (the pages
+            # this plan already retained are pinned, so they never count
+            # as evictable against themselves)
+            if self._pool.free + self._tree.evictable() >= need:
+                self._tree.evict(need)
+        fresh = self._pool.alloc(need)
+        if fresh is None:
+            if full:
+                self._pool.release(full)
+            if partial is not None:
+                self._pool.release([partial[0]])
+            return None
+        matched = len(full) * self.page_size + (partial[1]
+                                                if partial else 0)
+        return {"full": full, "partial": partial, "fresh": fresh,
+                "matched": matched, "total_pages": total_pages}
+
+    def _install_paged(self, slot: _Slot, req: _LMRequest, plan) -> None:
+        slot.req = req
+        slot.generated = []
+        slot.fed = plan["matched"]
+        slot.pos = plan["matched"]
+        slot.shared = list(plan["full"])
+        slot.owned = list(plan["fresh"])
+        slot.inserted = False
+        row = np.zeros((self.max_pages,), np.int32)
+        n_full = len(plan["full"])
+        row[:n_full] = plan["full"]
+        row[n_full:plan["total_pages"]] = plan["fresh"]
+        slot.table = row
+        if plan["partial"] is not None:
+            # copy-on-write: the divergence page's matched tokens are
+            # valid KV; copy it into this lane's first fresh page and
+            # overwrite from the divergence offset.  The source stays
+            # retained until the device copy lands (eviction must not
+            # recycle it first); _drain_step executes and releases.
+            src, _ = plan["partial"]
+            self._pending_cow.append({"src": int(src),
+                                      "dst": int(plan["fresh"][0])})
+        self.metrics.record_prefix_query(plan["matched"])
+
     def _admit_locked(self) -> None:
-        """Queued prompts join free slots; the slot restarts at position
-        0 — stale KV beyond a slot's position is masked, so no reset of
-        the cache buffers is needed.  Doomed work is shed first: an
-        abandoned request's slot is freed (its client gave up — further
-        decode steps are wasted device time; slot state is worker-owned,
-        so this is the one safe place to free it), and an expired or
-        abandoned queue item must never occupy a slot.  The queue sweep
-        is one rebuild pass — per-item `deque.remove` would be O(n^2)
-        under exactly the overload storm it exists for."""
+        """Queued prompts join free slots.  Doomed work is shed first:
+        an abandoned request's slot (and pages) is freed, and an expired
+        or abandoned queue item must never occupy a slot.  The queue
+        sweep is one rebuild pass — per-item `deque.remove` would be
+        O(n^2) under exactly the overload storm it exists for.  Paged
+        admission is FIFO: when the head request's pages cannot be
+        supplied even after eviction, admission stops rather than
+        letting smaller later requests starve it forever."""
         for slot in self._slots:
             if slot.active and slot.req.abandoned:
                 self.metrics.record_shed()
+                self._free_slot_pages(slot)
                 slot.req = None
         now = time.perf_counter()
         kept, shed = collections.deque(), 0
@@ -354,21 +668,79 @@ class ContinuousLMServer:
                 break
             if slot.active:
                 continue
-            slot.req = self._queue.popleft()
-            slot.pos = 0
-            slot.fed = 0
-            slot.generated = []
+            if self.kv == "paged":
+                plan = self._plan_admission_paged(self._queue[0])
+                if plan is None:
+                    break              # head-of-line waits for pages
+                req = self._queue.popleft()
+                self._install_paged(slot, req, plan)
+            else:
+                slot.req = self._queue.popleft()
+                slot.pos = 0
+                slot.fed = 0
+                slot.generated = []
         self.metrics.set_queue_depth(len(self._queue))
+        if self.kv == "paged" and self._pool is not None:
+            self.metrics.set_pages(self._pool.in_use, self._pool.free,
+                                   self.kv_pages)
+
+    def _finish_slot(self, slot: _Slot) -> None:
+        """Completion fold: resolve the client, free the lane + pages."""
+        if slot.req.abandoned:
+            # the client timed out mid-decode and already got
+            # DeadlineExceededError: the finished sequence is
+            # discarded work, not a served request
+            self.metrics.record_shed()
+        else:
+            slot.req.result = slot.req.prompt + slot.generated
+            self.metrics.record_request(
+                time.perf_counter() - slot.req.enqueued)
+            slot.req.event.set()
+        self._free_slot_pages(slot)
+        slot.req = None
+
+    def _insert_prompt_pages(self, slot: _Slot) -> None:
+        """Prefill just completed: register this prompt's FULL pages in
+        the radix tree so the next shared-prefix request skips them.
+        Page-granular — a prompt shorter than one page caches nothing."""
+        if self.kv != "paged" or slot.inserted:
+            return
+        slot.inserted = True
+        plen = len(slot.req.prompt)
+        n_full = plen // self.page_size
+        if n_full:
+            self._tree.insert(slot.req.prompt[:n_full * self.page_size],
+                              [int(p) for p in slot.table[:n_full]])
 
     def _drain_step(self) -> bool:
         """One scheduling round: admit, build the step inputs, dispatch,
         fold the sampled tokens back into each lane.  Returns False when
         idle (nothing active, nothing queued)."""
         with self._cond:
+            # a pending warmup runs on the worker's own cache, inside
+            # this protected loop (a failing warm dispatch rides the
+            # same fault path as a failing decode).  The paged step
+            # with n_feed=0 touches only the null page, so it is safe
+            # even alongside live lanes; the dense warm waits for idle
+            # (its unconditional pos-0 write would clobber active rows)
+            warm = self._warm_req
+            idle = not any(s.active for s in self._slots)
+            if warm is not None and (idle or self.kv == "paged"):
+                self._warm_req = None
+            else:
+                warm = None
+        if warm is not None:
+            try:
+                self._warm_programs()
+            finally:
+                warm.set()
+            return True
+        with self._cond:
             self._admit_locked()
             active = [s for s in self._slots if s.active]
             if not active:
                 return False
+            cow, self._pending_cow = self._pending_cow, []
         if self.breaker is not None and not self.breaker.allow_dispatch():
             # open breaker: fast-fail whatever is in flight rather than
             # burning decode steps on a failing device
@@ -376,19 +748,30 @@ class ContinuousLMServer:
                 "circuit breaker open: decode fast-failed",
                 retry_after_s=self.breaker.retry_after_s())
             with self._cond:
+                for item in cow:
+                    # un-executed CoW copies hold a retention on their
+                    # source page; the lane that wanted them is failing
+                    self._pool.release([item["src"]])
                 for s in self._slots:
                     if s.active:
                         self.metrics.record_shed()
                         s.req.error = err
                         s.req.event.set()
+                        self._free_slot_pages(s)
                         s.req = None
             return True
         if self._cache is None:
             # a failed step consumed its donated k/v buffers and set the
             # cache aside; rebuild INSIDE the protected loop so a failing
             # rebuild fails this round's requests instead of killing the
-            # worker thread (slots restart at pos 0 — no state to keep)
+            # worker thread (page/radix state was already reset by the
+            # fault handler — slots restart at pos 0, nothing to keep)
             self._reset_cache()
+        if self.kv == "paged":
+            return self._dispatch_paged(active, cow)
+        return self._dispatch_dense(active)
+
+    def _dispatch_dense(self, active) -> bool:
         token = np.zeros((self.n_slots,), np.int32)
         pos = np.zeros((self.n_slots,), np.int32)
         temp = np.zeros((self.n_slots,), np.float32)
@@ -426,20 +809,84 @@ class ContinuousLMServer:
             slot.generated.append(int(nxt[i]))
             emitted += 1
             if len(slot.generated) >= slot.req.max_new:
-                if slot.req.abandoned:
-                    # the client timed out mid-decode and already got
-                    # DeadlineExceededError: the finished sequence is
-                    # discarded work, not a served request
-                    self.metrics.record_shed()
-                else:
-                    slot.req.result = slot.req.prompt + slot.generated
-                    self.metrics.record_request(
-                        time.perf_counter() - slot.req.enqueued)
-                    slot.req.event.set()
-                slot.req = None
+                self._finish_slot(slot)
         self.metrics.record_dispatch(len(active), self.n_slots)
         if emitted:
             self.metrics.record_tokens(emitted)
+        return True
+
+    def _dispatch_paged(self, active, cow) -> bool:
+        # land pending copy-on-write pages first: the divergence page's
+        # matched prefix must be resident before its lane's first feed
+        for item in cow:
+            k, v = self._copy(*self._cache, np.int32(item["src"]),
+                              np.int32(item["dst"]))
+            self._cache = (k, v)
+            self._pool.release([item["src"]])
+        # chunk width: the wide program dispatches only while some lane
+        # has a FULL chunk of prompt left to feed — sub-chunk tails and
+        # pure-decode rounds ride the 1-wide program.  Short-prompt
+        # traffic therefore never compiles (or pays for) the wide
+        # program at all; a long prompt costs ceil(P/chunk) wide
+        # dispatches plus its tail.
+        width = 1
+        if self._chunk_step is not None:
+            for s in active:
+                if len(s.req.prompt) - s.fed >= self.prefill_chunk:
+                    width = self.prefill_chunk
+                    break
+        tokens = np.zeros((self.n_slots, width), np.int32)
+        pos = np.zeros((self.n_slots,), np.int32)
+        n_feed = np.zeros((self.n_slots,), np.int32)
+        temp = np.zeros((self.n_slots,), np.float32)
+        seeds = np.zeros((self.n_slots,), np.int32)
+        counts = np.zeros((self.n_slots,), np.int32)
+        table = np.zeros((self.n_slots, self.max_pages), np.int32)
+        for i, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            req = slot.req
+            remaining = len(req.prompt) - slot.fed
+            if remaining > 0:                  # chunked prefill
+                f = min(remaining, width)
+                tokens[i, :f] = req.prompt[slot.fed:slot.fed + f]
+                n_feed[i] = f
+            else:                              # decode: feed last sample
+                tokens[i, 0] = slot.generated[-1]
+                n_feed[i] = 1
+            pos[i] = slot.pos
+            temp[i] = req.temperature
+            seeds[i] = req.seed
+            counts[i] = len(slot.generated)
+            table[i] = slot.table
+        nxt, k, v = self._step(self.params, *self._cache, table, pos,
+                               n_feed, tokens, temp, seeds, counts)
+        if self.breaker is not None:
+            self.breaker.record_success()
+        self._cache = (k, v)
+        nxt = np.asarray(nxt)
+        self._steps += 1
+        emitted = 0
+        for i, slot in enumerate(self._slots):
+            if not slot.active or n_feed[i] == 0:
+                continue
+            slot.pos += int(n_feed[i])
+            if slot.fed < len(slot.req.prompt):
+                slot.fed += int(n_feed[i])
+                if slot.fed < len(slot.req.prompt):
+                    continue
+                # prefill complete: its full pages become reusable, and
+                # the last prompt token's logits yield the first sample
+                self._insert_prompt_pages(slot)
+            slot.generated.append(int(nxt[i]))
+            emitted += 1
+            if len(slot.generated) >= slot.req.max_new:
+                self._finish_slot(slot)
+        self.metrics.record_dispatch(len(active), self.n_slots)
+        if emitted:
+            self.metrics.record_tokens(emitted)
+        self.metrics.set_pages(self._pool.in_use, self._pool.free,
+                               self.kv_pages)
         return True
 
     def _run(self) -> None:
@@ -453,6 +900,14 @@ class ContinuousLMServer:
                     for s in self._slots:
                         s.req = None
                     self._queue.clear()
+                    # page contents survive a stop only as long as the
+                    # buffers do — release everything in one sweep
+                    self._reset_pool()
+                    if self._warm_req is not None:
+                        # a warmup() waiting on a stopped server must
+                        # unblock, not sit out its timeout
+                        self._warm_req.set()
+                        self._warm_req = None
                     for r in victims:
                         self.metrics.record_shed()
                         r.error = ServingUnavailableError(
@@ -470,11 +925,16 @@ class ContinuousLMServer:
                         s.req.error = e
                         s.req.event.set()
                         s.req = None
-                # the failed step may have consumed its donated k/v
-                # buffers; mark the cache dead so the next round rebuilds
-                # it inside this same protected loop (a rebuild that
-                # throws then fails THAT round's requests, not the worker)
-                self._cache = None
+                    # the failed step consumed its donated k/v buffers
+                    # AND whatever pages the radix tree pointed into:
+                    # reset the host page state NOW (pure Python, cannot
+                    # fail) so the next admit round allocates against a
+                    # coherent pool, and mark the device cache dead so
+                    # the next round rebuilds it inside this same
+                    # protected loop (a rebuild that throws then fails
+                    # THAT round's requests, not the worker)
+                    self._reset_pool()
+                    self._cache = None
                 busy = True
             if not busy:
                 with self._cond:

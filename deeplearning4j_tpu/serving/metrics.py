@@ -40,6 +40,15 @@ class ServingMetrics:
         self._poison_isolated = 0  # requests isolated as poison by bisection
         self._breaker_state = "closed"
         self._breaker_opens = 0
+        # paged-KV / prefix-reuse ledger (ISSUE-7): every admitted LM
+        # request is one prefix query; a hit means cached prompt pages
+        # were reused and `tokens_saved` prefill steps were skipped
+        self._prefix_queries = 0
+        self._prefix_hits = 0
+        self._prefix_tokens_saved = 0
+        self._pages_in_use = 0     # gauge: KV pages currently refcounted
+        self._pages_free = 0
+        self._pages_total = 0      # 0 = not a paged pool
 
     # ---- recording --------------------------------------------------------
 
@@ -93,6 +102,22 @@ class ServingMetrics:
             self._touch()
             self._poison_isolated += int(n)
 
+    def record_prefix_query(self, tokens_saved: int) -> None:
+        """One LM admission's radix-cache outcome: `tokens_saved` prompt
+        tokens were served from cached pages (0 = miss)."""
+        with self._lock:
+            self._touch()
+            self._prefix_queries += 1
+            if tokens_saved > 0:
+                self._prefix_hits += 1
+                self._prefix_tokens_saved += int(tokens_saved)
+
+    def set_pages(self, in_use: int, free: int, total: int) -> None:
+        with self._lock:
+            self._pages_in_use = int(in_use)
+            self._pages_free = int(free)
+            self._pages_total = int(total)
+
     def set_breaker_state(self, state: str) -> None:
         with self._lock:
             if state == "open" and self._breaker_state != "open":
@@ -125,6 +150,10 @@ class ServingMetrics:
             poison = self._poison_isolated
             breaker_state = self._breaker_state
             breaker_opens = self._breaker_opens
+            pq, ph = self._prefix_queries, self._prefix_hits
+            psaved = self._prefix_tokens_saved
+            pages = (self._pages_in_use, self._pages_free,
+                     self._pages_total)
         out = {
             "requests": requests,
             "dispatches": dispatches,
@@ -138,6 +167,13 @@ class ServingMetrics:
             "breaker_opens": breaker_opens,
             "latency": self.latency.summary(),
         }
+        if pq:
+            out["prefix_queries"] = pq
+            out["prefix_hits"] = ph
+            out["prefix_tokens_saved"] = psaved
+            out["prefix_hit_rate"] = round(ph / pq, 3)
+        if pages[2]:
+            out["pages_in_use"], out["pages_free"], out["pages_total"] = pages
         if dispatches:
             out["mean_batch_occupancy"] = round(rows / dispatches, 3)
             out["max_batch_occupancy"] = max_occ

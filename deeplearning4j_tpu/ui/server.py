@@ -471,13 +471,20 @@ class UiServer:
                  max_queue_depth: Optional[int] = None,
                  default_deadline_s: Optional[float] = None,
                  breaker_threshold: Optional[int] = 5,
-                 breaker_cooldown_s: float = 1.0) -> "UiServer":
+                 breaker_cooldown_s: float = 1.0,
+                 kv: str = "paged", page_size: int = 16,
+                 pages: Optional[int] = None,
+                 prefill_chunk: int = 8) -> "UiServer":
         """Register a TransformerLM for POST /lm/generate.  With
         `continuous` (default) greedy/temperature requests decode in a
         `slots`-lane continuous batching pool; `continuous=False` keeps
         every request on the whole-sequence path.  `max_queue_depth`,
         `default_deadline_s` and the breaker knobs configure the
-        serving-plane resilience layer (docs/robustness.md)."""
+        serving-plane resilience layer (docs/robustness.md).  `kv`,
+        `page_size`, `pages` and `prefill_chunk` configure the paged KV
+        pool with radix prefix reuse (docs/performance.md "The KV
+        memory cost model"); `kv="dense"` keeps the original per-slot
+        dense cache."""
         lm_server = None
         if continuous:
             from deeplearning4j_tpu.serving import (
@@ -490,7 +497,9 @@ class UiServer:
                        if breaker_threshold else None)
             lm_server = ContinuousLMServer(
                 cfg, params, slots=slots, max_queue_depth=max_queue_depth,
-                default_deadline_s=default_deadline_s, breaker=breaker)
+                default_deadline_s=default_deadline_s, breaker=breaker,
+                kv=kv, page_size=page_size, pages=pages,
+                prefill_chunk=prefill_chunk)
         with self.state.lock:
             self.state.lm = (cfg, params)
             old = self.state.lm_server

@@ -42,6 +42,11 @@ def pytest_configure(config):
         "markers", "fleet: serving-fleet tests — failover router, health "
         "ejection/re-admission, rolling weight swaps, fleet chaos (fast; "
         "run in tier-1)")
+    config.addinivalue_line(
+        "markers", "paged: paged-KV tests — block-table pool parity, "
+        "radix prefix reuse + copy-on-write, chunked prefill, page "
+        "refcount ledger under chaos, compile-count guard (fast; run "
+        "in tier-1)")
 
 
 @pytest.fixture

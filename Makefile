@@ -3,7 +3,7 @@
 
 .PHONY: test test-serving test-precision test-fleet test-paged dryrun \
 	bench smoke serving-smoke bench-precision bench-fleet bench-paged \
-	evidence lint
+	test-obs bench-obs obs-smoke evidence lint
 
 test:
 	python -m pytest tests/ -x -q
@@ -33,6 +33,20 @@ test-paged:
 # (docs/performance.md "The KV memory cost model").
 bench-paged:
 	BENCH_ONLY=paged python bench.py
+
+# Observability-plane tests only (metrics registry + exposition,
+# request tracing across the fleet, compile watcher, training
+# telemetry; docs/observability.md).
+test-obs:
+	python -m pytest tests/ -q -m obs
+
+# Observability-overhead bench row: serving storm with the full
+# observability plane on vs off (gate: >= 0.97x baseline requests/s).
+bench-obs:
+	BENCH_ONLY=obs python bench.py
+
+# The obs CI gate: tests + the overhead row.
+obs-smoke: test-obs bench-obs
 
 # Broad-except linter (see docs/robustness.md): fails on new bare
 # `except Exception:` in deeplearning4j_tpu/ without a noqa pragma.

@@ -116,6 +116,30 @@ def cmd_train(args) -> int:
     epochs = int(props.get("train.epochs", args.epochs))
     batch = int(props.get("train.batch.size", args.batch))
 
+    # Observability plane (ISSUE-8): -metrics-port starts a standalone
+    # /metrics endpoint for the run and attaches a TrainingTelemetry
+    # listener (same slot as ScoreIterationListener, chunk-aware) —
+    # step time, examples/sec, grad norm, loss-scale events, supervisor
+    # interventions.  The telemetry snapshot also rides every
+    # resilience checkpoint manifest.
+    telemetry = metrics_srv = None
+    if args.metrics_port is not None:
+        from deeplearning4j_tpu.obs import (
+            MetricsRegistry,
+            MetricsServer,
+            TrainingTelemetry,
+        )
+
+        registry = MetricsRegistry()
+        telemetry = TrainingTelemetry(registry=registry,
+                                      sync_interval=args.metrics_interval,
+                                      batch_size=batch)
+        net.add_listener(telemetry)
+        metrics_srv = MetricsServer(registry,
+                                    port=args.metrics_port).start()
+        print(f"train: metrics on {metrics_srv.url}/metrics "
+              f"(every {telemetry.sync_interval} steps)")
+
     precision = props.get("train.precision", args.precision)
     if precision and precision != "fp32":
         # Precision plane: "bf16" = pure bf16 params+compute, "mixed" =
@@ -199,7 +223,8 @@ def cmd_train(args) -> int:
         if accum > 1:
             print("-accum is ignored under -resilience")
             accum = 1
-        sup = TrainingSupervisor(runner, ResilienceConfig(
+        sup = TrainingSupervisor(runner, telemetry=telemetry,
+                                 config=ResilienceConfig(
             checkpoint_dir=ckpt_dir,
             checkpoint_every=args.ckpt_every,
             keep=args.ckpt_keep,
@@ -267,6 +292,13 @@ def cmd_train(args) -> int:
           f"({total / max(elapsed, 1e-9):.1f} examples/sec)")
     print(ev.stats())
     print(f"Model saved to {out / 'model'}")
+    if metrics_srv is not None:
+        snap = telemetry.snapshot()
+        print(f"train: telemetry — {snap['steps']} steps, "
+              f"{snap['examples_per_sec']:.1f} examples/sec"
+              + (f", interventions {snap['interventions']}"
+                 if snap.get("interventions") else ""))
+        metrics_srv.stop()
     return 0
 
 
@@ -459,7 +491,8 @@ def cmd_serve(args) -> int:
           f"breaker_threshold={breaker_n or 'off'} "
           f"drain_grace_s={args.drain_grace_s}")
     print(f"Serving on {srv.url} — POST /model/predict, /lm/generate; "
-          f"GET /serving/stats, /healthz, /readyz")
+          f"GET /serving/stats, /metrics, /trace/recent, /healthz, "
+          f"/readyz")
 
     # SIGTERM -> graceful drain (the serving analog of the training
     # supervisor's preemption handler): stop admission, let in-flight
@@ -565,7 +598,8 @@ def cmd_serve_fleet(args) -> int:
           f"autoscale {'on' if args.autoscale else 'off'} "
           f"[{router.min_replicas}, {router.max_replicas}]")
     print(f"Serving fleet on {front.url} — POST /model/predict; "
-          f"GET /fleet/stats, /serving/stats, /healthz, /readyz")
+          f"GET /fleet/stats, /serving/stats, /metrics, /trace/recent, "
+          f"/healthz, /readyz")
 
     # SIGTERM -> fleet-wide graceful drain: the front stops admission
     # (503 + /readyz not-ready), every replica drains its in-flight
@@ -921,6 +955,18 @@ def build_parser() -> argparse.ArgumentParser:
                          dest="step_timeout", type=float, default=None,
                          help="watchdog: fail a training step exceeding "
                               "this many seconds (default: no watchdog)")
+    p_train.add_argument("-metrics-port", "--metrics-port",
+                         dest="metrics_port", type=int, default=None,
+                         help="serve training telemetry (Prometheus "
+                              "/metrics: step time, examples/sec, grad "
+                              "norm, loss-scale events, supervisor "
+                              "interventions) on this port (0 = pick a "
+                              "free port; default: off)")
+    p_train.add_argument("-metrics-interval", "--metrics-interval",
+                         dest="metrics_interval", type=int, default=10,
+                         help="steps between telemetry syncs (the "
+                              "listener's sync_interval: off-interval "
+                              "steps never force a host sync)")
     p_train.set_defaults(fn=cmd_train)
 
     p_lm = sub.add_parser(

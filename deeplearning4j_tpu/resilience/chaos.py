@@ -270,7 +270,8 @@ class _FleetChaos:
                 return r
         return None
 
-    def _dispatch(self, replica, path, body, timeout=None):
+    def _dispatch(self, replica, path, body, timeout=None,
+                  request_id=None):
         cfg = self.config
         with self._lock:
             i = self.attempts
@@ -286,7 +287,8 @@ class _FleetChaos:
         if (i in cfg.slow_attempt_steps
                 and cfg.slow_replica in (None, replica.name)):
             time.sleep(cfg.slow_seconds)
-        return self._orig_dispatch(replica, path, body, timeout)
+        return self._orig_dispatch(replica, path, body, timeout,
+                                   request_id=request_id)
 
     def _probe(self, replica) -> bool:
         with self._lock:

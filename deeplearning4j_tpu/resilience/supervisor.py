@@ -120,9 +120,15 @@ class TrainingSupervisor:
     params/updater state for checkpointing and the ``lr_scale`` hook.
     """
 
-    def __init__(self, runner, config: ResilienceConfig):
+    def __init__(self, runner, config: ResilienceConfig, telemetry=None):
         self.runner = runner
         self.config = config
+        # observability plane (ISSUE-8): an optional
+        # `obs.TrainingTelemetry` receives every supervisor intervention
+        # (rollback / poison_skip / preemption / checkpoint) as a
+        # counter, and its snapshot is embedded in each checkpoint
+        # manifest so a resumed run can see its predecessor's telemetry
+        self.telemetry = telemetry
         self.net = getattr(runner, "net", runner)
         if self.net.params is None:
             self.net.init()
@@ -191,13 +197,20 @@ class TrainingSupervisor:
         publish = getattr(self.runner, "publish_train_state", None)
         if callable(publish):
             publish()
+        merged = {"lr_scale": float(self.net._lr_scale),
+                  "batches_consumed": int(self.batches_consumed),
+                  **(extra or {})}
+        if self.telemetry is not None:
+            self.telemetry.record_intervention("checkpoint")
+            # snapshot the training telemetry into the manifest: step
+            # rate, loss-scale events and the intervention ledger
+            # survive the pod with the checkpoint
+            merged["telemetry"] = self.telemetry.snapshot()
         save_checkpoint(
             self._dir, self.step, self.net.params,
             updater_state=self._published_updater_state(),
             net_state=getattr(self.net, "state", None),
-            extra={"lr_scale": float(self.net._lr_scale),
-                   "batches_consumed": int(self.batches_consumed),
-                   **(extra or {})},
+            extra=merged,
             keep=self.config.keep, score=score,
             keep_best=self.config.keep_best)
 
@@ -256,6 +269,8 @@ class TrainingSupervisor:
         self.rollbacks += 1
         report.action = "rollback"
         self.faults.append(report)
+        if self.telemetry is not None:
+            self.telemetry.record_intervention("rollback")
         if self.rollbacks > self.config.max_rollbacks:
             report.action = "abort"
             raise SupervisorAbort(
@@ -283,6 +298,8 @@ class TrainingSupervisor:
     def _emergency_checkpoint(self, report: FaultReport) -> None:
         report.action = "checkpoint_and_exit"
         self.faults.append(report)
+        if self.telemetry is not None:
+            self.telemetry.record_intervention("preemption")
         # Written even mid-suspect-streak: losing everything since the
         # last periodic checkpoint is worse than a possibly-diverged but
         # flagged snapshot — the flag lets operators (and a future resume)
@@ -326,6 +343,8 @@ class TrainingSupervisor:
         """Bookkeeping for one skipped poison batch (shared by the
         per-step and chunked loops); raises on budget exhaustion."""
         self.skipped += 1
+        if self.telemetry is not None:
+            self.telemetry.record_intervention("poison_skip")
         report = FaultReport(
             kind=NAN_BATCH, step=self.step, action="skip",
             detail=f"non-finite values in input batch "

@@ -23,7 +23,13 @@ coalescing K concurrent *requests* per device dispatch.
 - `ServingMetrics` — queue depth, batch occupancy, p50/p95/p99 latency,
   requests/s and tokens/s, plus the resilience ledger (`rejected`,
   `shed`, `deadline_missed`, `poison_isolated`, `breaker_state`)
-  (`metrics.py`), surfaced via the UI server's `GET /serving/stats`;
+  (`metrics.py`), surfaced via the UI server's `GET /serving/stats`.
+  Since ISSUE-8 the cells are `obs.registry` metric objects: the same
+  values render as Prometheus text at `GET /metrics`, end-to-end
+  latency is split into queue-wait vs dispatch-compute histograms,
+  every request is traced (`GET /trace/recent`, X-Request-Id
+  propagated across the fleet), and XLA compiles are first-class
+  (`compiles_total{program_key=...}`) — docs/observability.md;
 - serving-plane resilience (`resilience.py`, ISSUE-4): typed failures
   (`ServingOverloadError` -> 503 + Retry-After, `DeadlineExceededError`
   -> 504, `ServingUnavailableError` -> 503, `CircuitOpenError`,

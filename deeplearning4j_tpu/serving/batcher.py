@@ -43,6 +43,13 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.obs.compilewatch import compile_watcher
+from deeplearning4j_tpu.obs.trace import (
+    TraceRecorder,
+    new_request_id,
+    span,
+    trace,
+)
 from deeplearning4j_tpu.resilience.retry import RetryPolicy, backoff_delays
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.resilience import (
@@ -61,12 +68,34 @@ _BISECT_POLICY = RetryPolicy(max_attempts=8, base_delay=0.002,
                              retryable=(Exception,))
 
 
+def _build_serving_trace(raw):
+    """Materialize one batcher trace from its hot-path tuple (the
+    `TraceRecorder.record_lazy` builder — runs at /trace/recent read
+    time, never on the request path)."""
+    (rid, enqueued, t_start, t_end, done, status, rows, err,
+     compiles, wall) = raw
+    spans = [span("queue_wait", enqueued,
+                  t_start if t_start is not None else done)]
+    if t_start is not None:
+        te = t_end if t_end is not None else done
+        spans.append(span("dispatch", t_start, te, rows=rows))
+        spans.append(span("respond", te, done))
+        for c_end, c_dur, key in compiles or ():
+            spans.append(span("xla_compile", c_end - c_dur, c_end,
+                              program_key=key))
+    out = trace(rid, "serving", spans, status=status, rows=rows,
+                error=err)
+    out["wall_time"] = wall
+    return out
+
+
 class _Pending:
     __slots__ = ("x", "mask", "event", "result", "error", "enqueued",
-                 "deadline", "abandoned")
+                 "deadline", "abandoned", "request_id", "t_start", "t_end")
 
     def __init__(self, x: np.ndarray, mask: Optional[np.ndarray],
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 request_id: Optional[str] = None):
         self.x = x
         self.mask = mask
         self.event = threading.Event()
@@ -75,6 +104,9 @@ class _Pending:
         self.enqueued = time.perf_counter()
         self.deadline = deadline   # absolute perf_counter time, or None
         self.abandoned = False     # client gave up waiting (timeout race)
+        self.request_id = request_id   # X-Request-Id (tracing, ISSUE-8)
+        self.t_start: Optional[float] = None  # dispatch start (worker)
+        self.t_end: Optional[float] = None    # dispatch end (worker)
 
     @property
     def key(self):
@@ -106,7 +138,8 @@ class MicroBatcher:
                  default_deadline_s: Optional[float] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  max_bisect_depth: int = 6,
-                 bisect_policy: RetryPolicy = _BISECT_POLICY):
+                 bisect_policy: RetryPolicy = _BISECT_POLICY,
+                 tracer: Optional[TraceRecorder] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -122,6 +155,14 @@ class MicroBatcher:
         self.breaker = breaker
         self.max_bisect_depth = int(max_bisect_depth)
         self.bisect_policy = bisect_policy
+        # request tracing (ISSUE-8): None = tracing off.  The recorder
+        # is bounded, and span assembly is a handful of dict builds per
+        # request — the bench `obs` row gates the overhead.  The compile
+        # watcher is resolved ONCE: per-request global lookups (and the
+        # ensure-installed probe) are off the hot path.
+        self.tracer = tracer
+        self._compile_watch = compile_watcher() if tracer is not None \
+            else None
         self.metrics = metrics if metrics is not None else ServingMetrics()
         if breaker is not None:
             breaker.add_listener(self.metrics.set_breaker_state)
@@ -145,13 +186,16 @@ class MicroBatcher:
 
     def submit(self, x: np.ndarray, mask: Optional[np.ndarray] = None,
                timeout: Optional[float] = None,
-               deadline_s: Optional[float] = None) -> np.ndarray:
+               deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> np.ndarray:
         """Enqueue a [n, ...] request and block for its [n, ...] outputs.
 
         `timeout` bounds the *client's* wait; `deadline_s` (default
         `default_deadline_s`) is carried on the queue item so the worker
         sheds the request before dispatch once it expires — a client that
-        has already given up must not cost device time."""
+        has already given up must not cost device time.  `request_id`
+        names the request's trace when a tracer is attached (one is
+        minted otherwise)."""
         x = np.asarray(x)
         if x.ndim < 2 or x.shape[0] < 1:
             raise ValueError(f"request must be [n, ...] with n >= 1, got "
@@ -161,7 +205,10 @@ class MicroBatcher:
                              f"({self.max_batch}); split the request")
         if deadline_s is None:
             deadline_s = self.default_deadline_s
-        item = _Pending(x, None if mask is None else np.asarray(mask))
+        if request_id is None and self.tracer is not None:
+            request_id = new_request_id()
+        item = _Pending(x, None if mask is None else np.asarray(mask),
+                        request_id=request_id)
         if deadline_s is not None:
             item.deadline = item.enqueued + float(deadline_s)
         with self._cond:
@@ -208,12 +255,46 @@ class MicroBatcher:
                 # already resolve (and account) the item — a bare
                 # client-wait timeout is client impatience, not shedding
                 self.metrics.record_deadline_missed()
+            self._trace_item(item, time.perf_counter(), "timeout")
             raise DeadlineExceededError(
                 f"serving request timed out after {timeout}s")
+        done = time.perf_counter()
         if item.error is not None:
+            self._trace_item(item, done, "error")
             raise item.error
-        self.metrics.record_request(time.perf_counter() - item.enqueued)
+        qw = comp = None
+        if item.t_start is not None:
+            # the split the stats endpoint reports: time spent waiting
+            # for a dispatch slot vs time inside the dispatch itself
+            qw = item.t_start - item.enqueued
+            comp = (item.t_end if item.t_end is not None
+                    else done) - item.t_start
+        self.metrics.record_request(done - item.enqueued,
+                                    queue_wait_s=qw, compute_s=comp)
+        self._trace_item(item, done, "ok")
         return item.result
+
+    def _trace_item(self, item: _Pending, done: float,
+                    status: str) -> None:
+        """Record the request's lifecycle trace (queue_wait -> dispatch
+        -> respond, plus any overlapping xla_compile spans — the
+        off-ladder-recompile-in-THIS-request signal).  The hot path
+        captures one raw tuple; the span dicts materialize only when
+        /trace/recent is read (`record_lazy`)."""
+        if self.tracer is None:
+            return
+        compiles = None
+        if (item.t_start is not None
+                and self._compile_watch.any_since(item.t_start)):
+            compiles = self._compile_watch.events_between(
+                item.t_start, item.t_end if item.t_end is not None
+                else done)
+        self.tracer.record_lazy(_build_serving_trace, (
+            item.request_id or new_request_id(), item.enqueued,
+            item.t_start, item.t_end, done, status,
+            int(item.x.shape[0]),
+            str(item.error) if item.error is not None else None,
+            compiles, time.time()))
 
     def stop(self) -> None:
         with self._cond:
@@ -357,6 +438,11 @@ class MicroBatcher:
         concat AND result scatter, not just the dispatch: a MemoryError
         building the batch or a malformed dispatch return must become
         per-request errors, never escape to kill the worker."""
+        # dispatch window stamps: feed the queue-wait/compute latency
+        # split and the per-request trace spans
+        t0 = time.perf_counter()
+        for g in group:
+            g.t_start = t0
         try:
             x = (group[0].x if len(group) == 1
                  else np.concatenate([g.x for g in group], axis=0))
@@ -366,6 +452,8 @@ class MicroBatcher:
                         else np.concatenate([g.mask for g in group],
                                             axis=0))
             out = np.asarray(self._dispatch(x, mask, x.shape[0]))
+            for g in group:
+                g.t_end = time.perf_counter()
             off = 0
             results = []
             for g in group:

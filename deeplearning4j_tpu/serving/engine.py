@@ -21,6 +21,12 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.obs.compilewatch import (
+    compile_scope,
+    compile_watcher,
+)
+from deeplearning4j_tpu.obs.registry import MetricsRegistry
+from deeplearning4j_tpu.obs.trace import TraceRecorder
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
 from deeplearning4j_tpu.serving.bucketing import BucketLadder
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
@@ -49,7 +55,9 @@ class ServingEngine:
                  default_deadline_s: Optional[float] = None,
                  breaker_threshold: Optional[int] = 5,
                  breaker_cooldown_s: float = 1.0,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None,
+                 tracer: Optional[TraceRecorder] = None,
+                 registry: Optional[MetricsRegistry] = None):
         self.net = net
         self.ladder = ladder if ladder is not None else BucketLadder()
         # Precision plane (ISSUE-5): `quantize="int8"` serves per-channel
@@ -71,6 +79,16 @@ class ServingEngine:
         self.input_dtype = (None if input_dtype is None
                             else np.dtype(input_dtype))
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # observability plane (ISSUE-8): publish this engine's metric
+        # cells on the server's registry and trace every request; the
+        # process-wide compile watcher attributes XLA compiles to the
+        # dispatch shape that triggered them (compiles_total)
+        self.tracer = tracer
+        if registry is not None:
+            self.metrics.register_into(registry, plane="classifier")
+        # install the process-wide compile listener BEFORE any warmup
+        # compile fires, or the first programs go uncounted
+        compile_watcher()
         self.max_programs = (max_programs if max_programs is not None
                              else self.ladder.program_bound)
         self._shape_lock = threading.Lock()
@@ -89,7 +107,7 @@ class ServingEngine:
             max_wait_ms=max_wait_ms, metrics=self.metrics,
             max_queue_depth=max_queue_depth,
             default_deadline_s=default_deadline_s,
-            breaker=self.breaker)
+            breaker=self.breaker, tracer=tracer)
         if self.batcher.max_batch > self.ladder.max_batch:
             raise ValueError(
                 f"max_batch ({self.batcher.max_batch}) exceeds the "
@@ -122,18 +140,29 @@ class ServingEngine:
             if shape in seen:
                 return
             if len(seen) >= self.max_programs:
+                # the guard's evidence now includes the first-class
+                # compile counter (ISSUE-8): how many XLA compiles this
+                # engine's dispatch scopes actually observed
+                observed = compile_watcher().total(prefix="classifier:")
                 raise UnservableShapeError(
                     f"compile-count guard: dispatch shape {shape} "
                     f"({dtype}) would exceed the {self.max_programs}-"
-                    f"program bound (seen: {sorted(seen)}); the bucket "
+                    f"program bound (seen: {sorted(seen)}; "
+                    f"compiles_total observed: {observed}); the bucket "
                     f"ladder is not covering the traffic")
             seen.add(shape)
 
     def _dispatch(self, x: np.ndarray, mask: Optional[np.ndarray],
                   n_real: int) -> np.ndarray:
         bucket = self.ladder.batch_bucket(n_real)
-        self._guard_shape((bucket,) + tuple(x.shape[1:]), x.dtype.str)
-        out = self._model().output_bucketed(x, mask=mask, ladder=self.ladder)
+        shape = (bucket,) + tuple(x.shape[1:])
+        self._guard_shape(shape, x.dtype.str)
+        # attribute any XLA compile this dispatch triggers to its ladder
+        # shape: compiles_total{program_key="classifier:..."} — on the
+        # warmed path this scope observes nothing
+        with compile_scope(f"classifier:{shape}"):
+            out = self._model().output_bucketed(x, mask=mask,
+                                                ladder=self.ladder)
         self.metrics.record_dispatch(n_real, bucket)
         return np.asarray(out)
 
@@ -153,23 +182,29 @@ class ServingEngine:
         return x, None, None
 
     def predict_proba(self, x, timeout: Optional[float] = None,
-                      deadline_s: Optional[float] = None) -> np.ndarray:
+                      deadline_s: Optional[float] = None,
+                      request_id: Optional[str] = None) -> np.ndarray:
         """[n, ...] features -> [n, classes] output activations (or
         [n, T, classes] for sequence-tagging outputs, sliced back to the
         request's own T).  `deadline_s` rides the queue item so expired
-        work is shed before dispatch (docs/robustness.md)."""
+        work is shed before dispatch (docs/robustness.md); `request_id`
+        names the request's trace (``X-Request-Id``)."""
         x, mask, t = self._prepare(x)
         out = self.batcher.submit(x, mask, timeout=timeout,
-                                  deadline_s=deadline_s)
+                                  deadline_s=deadline_s,
+                                  request_id=request_id)
         if t is not None and out.ndim == 3 and out.shape[1] != t:
             out = out[:, :t]       # drop the length-bucket padding steps
         return out
 
     def predict(self, x, timeout: Optional[float] = None,
-                deadline_s: Optional[float] = None) -> np.ndarray:
+                deadline_s: Optional[float] = None,
+                request_id: Optional[str] = None) -> np.ndarray:
         """[n, ...] features -> [n] argmax class indices."""
         return np.argmax(self.predict_proba(x, timeout=timeout,
-                                            deadline_s=deadline_s), axis=-1)
+                                            deadline_s=deadline_s,
+                                            request_id=request_id),
+                         axis=-1)
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -197,8 +232,10 @@ class ServingEngine:
                         else None)
                 # straight to the model — warmup is not traffic, so it
                 # registers shapes with the guard but not the metrics
-                self._guard_shape((b,) + tuple(x.shape[1:]), x.dtype.str)
-                model.output_bucketed(x, mask=mask, ladder=self.ladder)
+                wshape = (b,) + tuple(x.shape[1:])
+                self._guard_shape(wshape, x.dtype.str)
+                with compile_scope(f"classifier:{wshape}"):
+                    model.output_bucketed(x, mask=mask, ladder=self.ladder)
                 warmed += 1
         return warmed
 
@@ -212,6 +249,10 @@ class ServingEngine:
             out["compiled_programs"] = sum(
                 len(s) for s in self._seen_shapes.values())
         out["program_bound"] = self.max_programs
+        # first-class compile accounting (ISSUE-8): XLA compiles the
+        # watcher attributed to this engine's dispatch/warmup scopes
+        out["compiles_total"] = compile_watcher().total(
+            prefix="classifier:")
         out["accepting"] = self.accepting
         out["quantize"] = self.quantize
         if self._qnet is not None:

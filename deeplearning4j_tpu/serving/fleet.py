@@ -73,6 +73,18 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from deeplearning4j_tpu.obs.compilewatch import compile_watcher
+from deeplearning4j_tpu.obs.registry import (
+    EXPOSITION_CONTENT_TYPE,
+    MetricsRegistry,
+)
+from deeplearning4j_tpu.obs.trace import (
+    TraceRecorder,
+    chrome_trace,
+    new_request_id,
+    span,
+    trace,
+)
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.resilience import (
     BREAKER_CLOSED,
@@ -291,7 +303,8 @@ class FleetRouter:
                  min_replicas: int = 1, max_replicas: int = 8,
                  scale_up_depth: float = 4.0,
                  scale_down_depth: float = 0.5,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 tracer: Optional[TraceRecorder] = None):
         self.factory = factory
         self.replica_breaker_threshold = int(replica_breaker_threshold)
         self.replica_breaker_cooldown_s = float(replica_breaker_cooldown_s)
@@ -305,6 +318,13 @@ class FleetRouter:
         self.scale_up_depth = float(scale_up_depth)
         self.scale_down_depth = float(scale_down_depth)
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # fleet-level request tracing (ISSUE-8): every routed request
+        # gets ONE trace whose spans name each dispatch attempt and
+        # failover hop — a replica killed mid-storm shows up as a
+        # failed span on the corpse and a successful span on the
+        # replica that answered, under the same X-Request-Id the
+        # replicas' own serving planes traced
+        self.tracer = tracer if tracer is not None else TraceRecorder()
         self._lock = threading.Lock()
         self._replicas: List[Replica] = []
         self._seq = 0
@@ -434,22 +454,27 @@ class FleetRouter:
     # ---- transport --------------------------------------------------------
 
     def _http(self, method: str, url: str, body=None,
-              timeout: Optional[float] = None):
+              timeout: Optional[float] = None,
+              headers: Optional[Dict[str, str]] = None):
         data = None if body is None else json.dumps(body).encode()
         req = urllib.request.Request(
             url, data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
         with urllib.request.urlopen(
                 req, timeout=(timeout if timeout is not None
                               else self.request_timeout_s)) as resp:
             return resp.status, json.loads(resp.read() or b"{}")
 
     def _dispatch(self, replica: Replica, path: str, body,
-                  timeout: Optional[float] = None):
+                  timeout: Optional[float] = None,
+                  request_id: Optional[str] = None):
         """One dispatch attempt against one replica.  Raises
         `FleetClientError` (4xx — never retried) or
         `_ReplicaDispatchError` (failover) on failure; feeds the
-        replica's breaker and router-side counters."""
+        replica's breaker and router-side counters.  `request_id` is
+        forwarded as ``X-Request-Id`` so the replica's serving plane
+        traces under the SAME id — including on failover resubmission."""
         if (replica.breaker is not None
                 and not replica.breaker.allow_dispatch()):
             # half-open single-probe discipline (same as batcher/lm):
@@ -464,8 +489,10 @@ class FleetRouter:
             replica.in_flight += 1
         try:
             try:
-                _, payload = self._http("POST", replica.url + path, body,
-                                        timeout)
+                _, payload = self._http(
+                    "POST", replica.url + path, body, timeout,
+                    headers=({"X-Request-Id": request_id}
+                             if request_id else None))
             except urllib.error.HTTPError as e:
                 status = e.code
                 try:
@@ -521,11 +548,25 @@ class FleetRouter:
         return payload
 
     def _submit(self, path: str, body, key: Optional[str] = None,
-                timeout: Optional[float] = None):
+                timeout: Optional[float] = None,
+                request_id: Optional[str] = None):
         """Failover loop: try routable replicas (excluded set grows per
         failure) until one answers or none remain.  Predict is pure, so
-        resubmitting a failed dispatch elsewhere is always safe."""
+        resubmitting a failed dispatch elsewhere is always safe.  The
+        whole loop is ONE trace under `request_id` (minted here when the
+        caller has none): one span per dispatch attempt plus a
+        failover_hop span per resubmission."""
         t0 = time.perf_counter()
+        rid = request_id or new_request_id()
+        spans: List[Dict] = []
+
+        def finish(status: str, error: Optional[str] = None):
+            self.tracer.record(trace(
+                rid, "fleet", spans, status=status, path=path,
+                failovers=sum(1 for s in spans
+                              if s["name"] == "failover_hop") or None,
+                error=error))
+
         # the client's deadline is a TOTAL budget across failovers: each
         # retry forwards only what remains of it, and an exhausted
         # budget is a typed 504 here — not a fresh full-deadline
@@ -540,6 +581,7 @@ class FleetRouter:
                 if remaining <= 0:
                     self.metrics.record_deadline_missed()
                     self.metrics.record_rejected()
+                    finish("timeout", error=str(last) if last else None)
                     raise DeadlineExceededError(
                         f"deadline of {deadline_ms:.0f}ms exhausted "
                         f"after {len(excluded)} failover(s)"
@@ -548,24 +590,40 @@ class FleetRouter:
             replica = self._pick(frozenset(excluded), key)
             if replica is None:
                 break
+            ta = time.perf_counter()
             try:
-                payload = self._dispatch(replica, path, body, timeout)
-            except FleetClientError:
+                payload = self._dispatch(replica, path, body, timeout,
+                                         request_id=rid)
+            except FleetClientError as e:
                 # the payload's fault everywhere — no failover, but it
                 # is still a typed rejection in the router's ledger:
                 # client_balanced (submitted == requests + rejected)
                 # must keep holding when some submissions are 4xx
+                spans.append(span("dispatch", ta, time.perf_counter(),
+                                  replica=replica.name, outcome="4xx"))
                 self.metrics.record_rejected()
+                finish("client_error", error=str(e))
                 raise
             except _ReplicaDispatchError as e:
+                tb = time.perf_counter()
+                spans.append(span(
+                    "dispatch", ta, tb, replica=replica.name,
+                    outcome=("fault" if e.replica_fault
+                             else "unavailable"), error=str(e)[:200]))
+                spans.append(span("failover_hop", tb, tb,
+                                  excluded=replica.name))
                 excluded.add(replica.name)
                 with self._lock:
                     self.failovers += 1
                 last = e
                 continue
+            spans.append(span("dispatch", ta, time.perf_counter(),
+                              replica=replica.name, outcome="ok"))
             self.metrics.record_request(time.perf_counter() - t0)
+            finish("ok")
             return payload
         self.metrics.record_rejected()
+        finish("unroutable", error=str(last) if last else None)
         raise ServingUnavailableError(
             "no routable replica" + (f" (last failure: {last})"
                                      if last else ""))
@@ -573,7 +631,8 @@ class FleetRouter:
     # ---- client surface ---------------------------------------------------
 
     def predict_proba(self, x, deadline_s: Optional[float] = None,
-                      timeout: Optional[float] = None) -> np.ndarray:
+                      timeout: Optional[float] = None,
+                      request_id: Optional[str] = None) -> np.ndarray:
         """[n, ...] features -> [n, classes] activations, served by
         whichever healthy replica the router picks (float32 survives the
         JSON hop bit-exactly: float32 -> float64 -> shortest-repr
@@ -581,20 +640,25 @@ class FleetRouter:
         body: Dict = {"features": np.asarray(x, np.float32).tolist()}
         if deadline_s is not None:
             body["deadline_ms"] = float(deadline_s) * 1e3
-        payload = self._submit("/model/predict", body, timeout=timeout)
+        payload = self._submit("/model/predict", body, timeout=timeout,
+                               request_id=request_id)
         return np.asarray(payload["outputs"], np.float32)
 
     def predict(self, x, deadline_s: Optional[float] = None,
-                timeout: Optional[float] = None) -> np.ndarray:
+                timeout: Optional[float] = None,
+                request_id: Optional[str] = None) -> np.ndarray:
         return np.argmax(self.predict_proba(x, deadline_s=deadline_s,
-                                            timeout=timeout), axis=-1)
+                                            timeout=timeout,
+                                            request_id=request_id),
+                         axis=-1)
 
     def generate_payload(self, prompt_ids: Sequence[int],
                          max_new_tokens: int, temperature: float = 0.0,
                          seed: int = 0, top_k: int = 0, top_p: float = 1.0,
                          beam_size: int = 0,
                          deadline_s: Optional[float] = None,
-                         timeout: Optional[float] = None) -> Dict:
+                         timeout: Optional[float] = None,
+                         request_id: Optional[str] = None) -> Dict:
         """LM generation with prefix-affinity routing: the first
         `affinity_prefix_tokens` prompt tokens pick the preferred
         replica via rendezvous hashing, so a shared system prompt keeps
@@ -617,7 +681,8 @@ class FleetRouter:
             body["beam_size"] = int(beam_size)
         if deadline_s is not None:
             body["deadline_ms"] = float(deadline_s) * 1e3
-        return self._submit("/lm/generate", body, key=key, timeout=timeout)
+        return self._submit("/lm/generate", body, key=key, timeout=timeout,
+                            request_id=request_id)
 
     def generate(self, prompt_ids: Sequence[int], max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
@@ -958,6 +1023,23 @@ class _FleetHandler(ServingHTTPMixin, BaseHTTPRequestHandler):
         return self.server.fleet_router  # type: ignore[attr-defined]
 
     def do_GET(self) -> None:  # noqa: N802
+        path, _, query = self.path.partition("?")
+        if path == "/metrics":
+            # Prometheus exposition: fleet-plane serving metrics,
+            # per-replica router-side gauges, breaker/page families,
+            # compiles_total (ISSUE-8, docs/observability.md)
+            registry = self.server.obs_registry  # type: ignore[attr-defined]
+            self._send(200, EXPOSITION_CONTENT_TYPE,
+                       registry.exposition().encode())
+            return
+        if path == "/trace/recent":
+            traces = self.router.tracer.recent()
+            if "format=chrome" in query:
+                self._json(200, chrome_trace(traces))
+            else:
+                self._json(200, {"traces": traces,
+                                 "recorded": self.router.tracer.recorded})
+            return
         if self.path == "/healthz":
             self._json(200, {"ok": True})
         elif self.path == "/readyz":
@@ -1013,7 +1095,8 @@ class _FleetHandler(ServingHTTPMixin, BaseHTTPRequestHandler):
                 self._json(400, {"error": "features required"})
                 return
             probs = self.router.predict_proba(
-                feats, deadline_s=self._deadline_s(body))
+                feats, deadline_s=self._deadline_s(body),
+                request_id=self.request_id())
             self._json(200, {
                 "predictions": np.argmax(probs, axis=-1).tolist(),
                 "outputs": np.asarray(probs).tolist()})
@@ -1032,7 +1115,8 @@ class _FleetHandler(ServingHTTPMixin, BaseHTTPRequestHandler):
                 top_k=int(body.get("top_k", 0)),
                 top_p=float(body.get("top_p", 1.0)),
                 beam_size=int(body.get("beam_size", 0)),
-                deadline_s=self._deadline_s(body))
+                deadline_s=self._deadline_s(body),
+                request_id=self.request_id())
             self._json(200, payload)
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
@@ -1049,9 +1133,68 @@ class FleetServer:
         self._server = _FleetHTTPServer((host, port), _FleetHandler)
         self._server.fleet_router = router  # type: ignore[attr-defined]
         self._server.fleet_draining = False  # type: ignore[attr-defined]
+        # observability plane (ISSUE-8): the fleet front's /metrics —
+        # fleet-plane serving metrics + per-replica router-side samples
+        # + the process-wide compile counter
+        self.registry = MetricsRegistry()
+        router.metrics.register_into(self.registry, plane="fleet")
+        self.registry.register_collector(self._fleet_samples)
+        self.registry.register_collector(
+            compile_watcher().collector_samples)
+        self.registry.gauge(
+            "server_uptime_seconds", "seconds since server construction",
+            fn=lambda: self.registry.uptime_s)
+        self._server.obs_registry = self.registry  # type: ignore[attr-defined]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name="fleet-front")
+
+    def _fleet_samples(self):
+        """Collector: router counters + per-replica router-side gauges
+        (sampled at scrape time, no HTTP fan-out — the replicas publish
+        their own planes on their own /metrics)."""
+        router = self.router
+        with router._lock:
+            counters = (("fleet_failovers_total", "counter",
+                         "failed dispatch attempts that moved on",
+                         router.failovers),
+                        ("fleet_swaps_total", "counter",
+                         "completed rolling swaps", router.swaps),
+                        ("fleet_scale_ups_total", "counter",
+                         "autoscale scale-ups", router.scale_ups),
+                        ("fleet_scale_downs_total", "counter",
+                         "autoscale scale-downs", router.scale_downs),
+                        ("fleet_health_polls_total", "counter",
+                         "health sweeps", router.health_polls),
+                        ("fleet_weights_version", "gauge",
+                         "current rolling-swap weights version",
+                         router._version))
+        from deeplearning4j_tpu.serving.metrics import _BREAKER_VALUES
+
+        for name, kind, help, value in counters:
+            yield (name, kind, help, {}, float(value))
+        for r in router.replicas():
+            labels = {"replica": r.name}
+            with r.lock:
+                samples = (("fleet_replica_in_flight", "gauge",
+                            "router-side in-flight requests",
+                            r.in_flight),
+                           ("fleet_replica_dispatches_total", "counter",
+                            "successful dispatches via the router",
+                            r.dispatches),
+                           ("fleet_replica_failures_total", "counter",
+                            "replica-fault dispatch failures",
+                            r.failures),
+                           ("fleet_replica_ejections_total", "counter",
+                            "breaker ejections", r.ejections),
+                           ("fleet_replica_readmissions_total", "counter",
+                            "breaker re-admissions", r.readmissions))
+            for name, kind, help, value in samples:
+                yield (name, kind, help, dict(labels), float(value))
+            state = r.breaker.state if r.breaker is not None else "closed"
+            yield ("fleet_replica_breaker_state", "gauge",
+                   "replica breaker (0 closed, 1 open, 2 half_open)",
+                   dict(labels), float(_BREAKER_VALUES.get(state, 0)))
 
     @property
     def url(self) -> str:

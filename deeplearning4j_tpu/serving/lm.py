@@ -70,6 +70,17 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.obs.compilewatch import (
+    compile_scope,
+    compile_watcher,
+)
+from deeplearning4j_tpu.obs.registry import MetricsRegistry
+from deeplearning4j_tpu.obs.trace import (
+    TraceRecorder,
+    new_request_id,
+    span,
+    trace,
+)
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.paged import PagePool, RadixPrefixCache
 from deeplearning4j_tpu.serving.resilience import (
@@ -107,10 +118,12 @@ def validate_request(cfg, prompt_ids, max_new_tokens: int) -> List[int]:
 
 class _LMRequest:
     __slots__ = ("prompt", "max_new", "temperature", "seed", "event",
-                 "result", "error", "enqueued", "deadline", "abandoned")
+                 "result", "error", "enqueued", "deadline", "abandoned",
+                 "request_id", "t_installed", "t_done", "prefix_matched")
 
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
-                 seed: int, deadline: Optional[float] = None):
+                 seed: int, deadline: Optional[float] = None,
+                 request_id: Optional[str] = None):
         self.prompt = list(prompt)
         self.max_new = int(max_new)
         self.temperature = float(temperature)
@@ -121,6 +134,10 @@ class _LMRequest:
         self.enqueued = time.perf_counter()
         self.deadline = deadline   # absolute perf_counter time, or None
         self.abandoned = False     # client gave up waiting
+        self.request_id = request_id       # X-Request-Id (ISSUE-8)
+        self.t_installed: Optional[float] = None  # slot-install stamp
+        self.t_done: Optional[float] = None       # decode-complete stamp
+        self.prefix_matched = 0            # radix-cache tokens reused
 
 
 class _Slot:
@@ -160,7 +177,9 @@ class ContinuousLMServer:
                  default_deadline_s: Optional[float] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  kv: str = "paged", page_size: int = 16,
-                 pages: Optional[int] = None, prefill_chunk: int = 8):
+                 pages: Optional[int] = None, prefill_chunk: int = 8,
+                 tracer: Optional[TraceRecorder] = None,
+                 registry: Optional[MetricsRegistry] = None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if max_queue_depth is not None and max_queue_depth < 1:
@@ -194,6 +213,13 @@ class ContinuousLMServer:
             raise ValueError(f"pages must be >= 1, got {self.kv_pages}")
         self.prefill_chunk = int(prefill_chunk)
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # observability plane (ISSUE-8): publish the LM pool's cells on
+        # the server registry, trace every request, and install the
+        # compile watcher before any program compiles
+        self.tracer = tracer
+        if registry is not None:
+            self.metrics.register_into(registry, plane="lm")
+        self._compile_watch = compile_watcher()
         if breaker is not None:
             breaker.add_listener(self.metrics.set_breaker_state)
             self.metrics.set_breaker_state(breaker.state)
@@ -244,13 +270,15 @@ class ContinuousLMServer:
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
                  timeout: Optional[float] = None,
-                 deadline_s: Optional[float] = None) -> List[int]:
+                 deadline_s: Optional[float] = None,
+                 request_id: Optional[str] = None) -> List[int]:
         """prompt ids -> full sequence (prompt + generated), blocking.
 
         `timeout` bounds the client's wait; `deadline_s` (default
         `default_deadline_s`) rides the queue item so the admitter sheds
         the request once it expires instead of spending decode steps on
-        a client that already gave up."""
+        a client that already gave up.  `request_id` names the request's
+        trace (``X-Request-Id``)."""
         ids = self.validate(prompt_ids, max_new_tokens)
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
@@ -259,7 +287,10 @@ class ContinuousLMServer:
         seed = int(seed) & 0x7FFFFFFF
         if deadline_s is None:
             deadline_s = self.default_deadline_s
-        req = _LMRequest(ids, int(max_new_tokens), temperature, seed)
+        if request_id is None and self.tracer is not None:
+            request_id = new_request_id()
+        req = _LMRequest(ids, int(max_new_tokens), temperature, seed,
+                         request_id=request_id)
         if deadline_s is not None:
             req.deadline = req.enqueued + float(deadline_s)
         with self._cond:
@@ -303,11 +334,44 @@ class ContinuousLMServer:
                 # deadline actually expired and the worker has not
                 # already accounted it (mirror of MicroBatcher.submit)
                 self.metrics.record_deadline_missed()
+            self._trace_request(req, time.perf_counter(), "timeout")
             raise DeadlineExceededError(
                 f"LM request timed out after {timeout}s")
+        done = time.perf_counter()
         if req.error is not None:
+            self._trace_request(req, done, "error")
             raise req.error
+        self._trace_request(req, done, "ok")
         return req.result
+
+    def _trace_request(self, req: _LMRequest, done: float,
+                       status: str) -> None:
+        """The LM request's lifecycle trace: queue_wait (admission to
+        slot install) then decode (install to completion), plus any XLA
+        compiles that landed inside the decode window."""
+        if self.tracer is None:
+            return
+        spans = []
+        t_in = req.t_installed if req.t_installed is not None else done
+        spans.append(span("queue_wait", req.enqueued, t_in))
+        if req.t_installed is not None:
+            t_done = req.t_done if req.t_done is not None else done
+            spans.append(span(
+                "decode", req.t_installed, t_done,
+                prompt_tokens=len(req.prompt),
+                generated=(len(req.result) - len(req.prompt)
+                           if req.result else 0),
+                prefix_matched=req.prefix_matched or None))
+            if self._compile_watch.any_since(req.t_installed):
+                for c_end, c_dur, key in (self._compile_watch
+                                          .events_between(req.t_installed,
+                                                          t_done)):
+                    spans.append(span("xla_compile", c_end - c_dur,
+                                      c_end, program_key=key))
+        self.tracer.record(trace(
+            req.request_id or new_request_id(), "lm", spans,
+            status=status, prompt_tokens=len(req.prompt),
+            error=(str(req.error) if req.error is not None else None)))
 
     def warmup(self, timeout: Optional[float] = 600.0) -> int:
         """Start the worker and pre-compile every device program before
@@ -350,8 +414,9 @@ class ContinuousLMServer:
         zi = np.zeros((self.n_slots,), np.int32)
         zf = np.zeros((self.n_slots,), np.float32)
         if self.kv == "dense":
-            _, k, v = self._step(self.params, *self._cache, zi, zi, zf,
-                                 zi, zi)
+            with compile_scope("lm:dense"):
+                _, k, v = self._step(self.params, *self._cache, zi, zi,
+                                     zf, zi, zi)
             self._cache = (k, v)
             return
         table = np.zeros((self.n_slots, self.max_pages), np.int32)
@@ -359,10 +424,12 @@ class ContinuousLMServer:
                         if self.prefill_chunk > 1 else [])
         for w in widths:
             tok = np.zeros((self.n_slots, w), np.int32)
-            _, k, v = self._step(self.params, *self._cache, table, zi,
-                                 zi, tok, zf, zi, zi)
+            with compile_scope(f"lm:paged[w{w}]"):
+                _, k, v = self._step(self.params, *self._cache, table,
+                                     zi, zi, tok, zf, zi, zi)
             self._cache = (k, v)
-        k, v = self._copy(*self._cache, np.int32(0), np.int32(0))
+        with compile_scope("lm:page_copy"):
+            k, v = self._copy(*self._cache, np.int32(0), np.int32(0))
         self._cache = (k, v)
 
     def compiled_programs(self) -> int:
@@ -473,6 +540,9 @@ class ContinuousLMServer:
             out["kv"] = kv
         out["max_len"] = self.cfg.max_len
         out["compiled_programs"] = self.compiled_programs()
+        # first-class compile accounting (ISSUE-8): XLA compiles the
+        # watcher attributed to the LM pool's dispatch scopes
+        out["compiles_total"] = compile_watcher().total(prefix="lm:")
         return out
 
     # ---- worker side ------------------------------------------------------
@@ -610,6 +680,8 @@ class ContinuousLMServer:
 
     def _install_paged(self, slot: _Slot, req: _LMRequest, plan) -> None:
         slot.req = req
+        req.t_installed = time.perf_counter()
+        req.prefix_matched = plan["matched"]
         slot.generated = []
         slot.fed = plan["matched"]
         slot.pos = plan["matched"]
@@ -676,6 +748,7 @@ class ContinuousLMServer:
                 self._install_paged(slot, req, plan)
             else:
                 slot.req = self._queue.popleft()
+                slot.req.t_installed = time.perf_counter()
                 slot.pos = 0
                 slot.fed = 0
                 slot.generated = []
@@ -693,8 +766,14 @@ class ContinuousLMServer:
             self.metrics.record_shed()
         else:
             slot.req.result = slot.req.prompt + slot.generated
+            now = time.perf_counter()
+            slot.req.t_done = now
+            t_in = slot.req.t_installed or now
+            # queue-wait vs decode-compute split (ISSUE-8 satellite)
             self.metrics.record_request(
-                time.perf_counter() - slot.req.enqueued)
+                now - slot.req.enqueued,
+                queue_wait_s=t_in - slot.req.enqueued,
+                compute_s=now - t_in)
             slot.req.event.set()
         self._free_slot_pages(slot)
         slot.req = None
@@ -789,8 +868,9 @@ class ContinuousLMServer:
             temp[i] = req.temperature
             seeds[i] = req.seed
             counts[i] = len(slot.generated)
-        nxt, k, v = self._step(self.params, *self._cache, pos, token,
-                               temp, seeds, counts)
+        with compile_scope("lm:dense"):
+            nxt, k, v = self._step(self.params, *self._cache, pos, token,
+                                   temp, seeds, counts)
         if self.breaker is not None:
             self.breaker.record_success()
         self._cache = (k, v)
@@ -819,8 +899,9 @@ class ContinuousLMServer:
         # land pending copy-on-write pages first: the divergence page's
         # matched prefix must be resident before its lane's first feed
         for item in cow:
-            k, v = self._copy(*self._cache, np.int32(item["src"]),
-                              np.int32(item["dst"]))
+            with compile_scope("lm:page_copy"):
+                k, v = self._copy(*self._cache, np.int32(item["src"]),
+                                  np.int32(item["dst"]))
             self._cache = (k, v)
             self._pool.release([item["src"]])
         # chunk width: the wide program dispatches only while some lane
@@ -859,8 +940,9 @@ class ContinuousLMServer:
             seeds[i] = req.seed
             counts[i] = len(slot.generated)
             table[i] = slot.table
-        nxt, k, v = self._step(self.params, *self._cache, table, pos,
-                               n_feed, tokens, temp, seeds, counts)
+        with compile_scope(f"lm:paged[w{width}]"):
+            nxt, k, v = self._step(self.params, *self._cache, table, pos,
+                                   n_feed, tokens, temp, seeds, counts)
         if self.breaker is not None:
             self.breaker.record_success()
         self._cache = (k, v)

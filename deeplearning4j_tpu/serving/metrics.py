@@ -7,6 +7,16 @@ tracks both sides — per-request latency percentiles via
 `runtime.profiler.LatencyRecorder`, and per-dispatch occupancy / queue
 depth / token counts — and snapshots them for `GET /serving/stats` and
 the bench rows.
+
+Since ISSUE-8 the cells themselves are `obs.registry` metric objects
+(counters/gauges/histograms), so one source of truth feeds BOTH the
+stats endpoints (`snapshot()`) and the Prometheus exposition at
+``GET /metrics``: `register_into(registry, plane=...)` publishes every
+cell under a plane label — no parallel snapshot dicts.  End-to-end
+latency is additionally SPLIT into queue-wait and dispatch-compute
+histograms (the batcher/LM pool stamp both timestamps), and every
+snapshot carries ``uptime_s`` plus a monotonic ``snapshot_at`` so
+scrapers can compute rates without client-side clocks.
 """
 
 from __future__ import annotations
@@ -15,7 +25,28 @@ import threading
 import time
 from typing import Dict, Optional
 
+from deeplearning4j_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
 from deeplearning4j_tpu.runtime.profiler import LatencyRecorder
+
+# breaker state -> gauge value (the exposition's numeric encoding;
+# the string stays in /serving/stats)
+_BREAKER_VALUES = {"closed": 0, "open": 1, "half_open": 2}
+
+
+def _ms(summary: Dict[str, float]) -> Dict[str, float]:
+    """A Histogram.summary() (seconds) as the stats-endpoint ms shape."""
+    if not summary.get("count"):
+        return {"count": 0}
+    return {"count": summary["count"],
+            "mean_ms": round(summary["mean"] * 1e3, 3),
+            "p50_ms": round(summary["p50"] * 1e3, 3),
+            "p95_ms": round(summary["p95"] * 1e3, 3),
+            "p99_ms": round(summary["p99"] * 1e3, 3)}
 
 
 class ServingMetrics:
@@ -24,112 +55,182 @@ class ServingMetrics:
     def __init__(self, latency_window: int = 4096):
         self._lock = threading.Lock()
         self.latency = LatencyRecorder(window=latency_window)
-        self._dispatches = 0
-        self._requests = 0
-        self._rows = 0          # real examples dispatched
-        self._padded_rows = 0   # bucket capacity dispatched (incl. padding)
-        self._tokens = 0        # LM tokens emitted
+        # ---- registry-native cells (ISSUE-8): the same objects render
+        # /serving/stats and /metrics
+        self.requests_total = Counter(
+            "serving_requests_total", "requests served to completion")
+        self.dispatches_total = Counter(
+            "serving_dispatches_total", "device dispatches")
+        self.rows_total = Counter(
+            "serving_rows_total", "real example rows dispatched")
+        self.padded_rows_total = Counter(
+            "serving_padded_rows_total",
+            "bucket capacity dispatched (incl. padding)")
+        self.tokens_total = Counter(
+            "serving_tokens_total", "LM tokens emitted")
+        self.queue_depth_gauge = Gauge(
+            "serving_queue_depth", "requests waiting in the queue")
+        # resilience ledger (ISSUE-4): submitted == requests + rejected
+        # + shed + other-errors
+        self.rejected_total = Counter(
+            "serving_rejected_total",
+            "refused at admission (overload/breaker/draining)")
+        self.shed_total = Counter(
+            "serving_shed_total", "removed from a queue before dispatch")
+        self.deadline_missed_total = Counter(
+            "serving_deadline_missed_total",
+            "failed because the deadline passed")
+        self.poison_isolated_total = Counter(
+            "serving_poison_isolated_total",
+            "requests isolated as poison by bisection")
+        self.breaker_state_gauge = Gauge(
+            "serving_breaker_state",
+            "circuit breaker state (0 closed, 1 open, 2 half_open)")
+        self.breaker_opens_total = Counter(
+            "serving_breaker_opens_total", "breaker open transitions")
+        # paged-KV / prefix-reuse ledger (ISSUE-7)
+        self.prefix_queries_total = Counter(
+            "serving_prefix_queries_total", "LM admissions radix-queried")
+        self.prefix_hits_total = Counter(
+            "serving_prefix_hits_total", "admissions that reused pages")
+        self.prefix_tokens_saved_total = Counter(
+            "serving_prefix_tokens_saved_total",
+            "prefill steps skipped via cached prefixes")
+        self.pages_in_use_gauge = Gauge(
+            "serving_kv_pages_in_use", "KV pages currently refcounted")
+        self.pages_free_gauge = Gauge(
+            "serving_kv_pages_free", "KV pages on the free list")
+        self.pages_total_gauge = Gauge(
+            "serving_kv_pages_total", "KV pool size (0 = not paged)")
+        # latency: end-to-end histogram + the queue-wait vs
+        # dispatch-compute split (ISSUE-8 satellite — the batcher knows
+        # both timestamps; before this they were collapsed into one
+        # end-to-end number)
+        self.latency_hist = Histogram(
+            "serving_request_seconds", "end-to-end request latency")
+        self.queue_wait_hist = Histogram(
+            "serving_queue_wait_seconds",
+            "admission to dispatch-start wait")
+        self.compute_hist = Histogram(
+            "serving_compute_seconds",
+            "dispatch-start to dispatch-end (device compute + pad)")
+        # ---- plain fields (cross-cell state the snapshot reads)
         self._queue_depth = 0
         self._max_occupancy = 0
         self._started: Optional[float] = None
-        # resilience counters (ISSUE-4): the admission/shedding ledger —
-        # submitted == requests + rejected + shed + other-errors
-        self._rejected = 0         # refused at admission (overload/breaker)
-        self._shed = 0             # removed from a queue before dispatch
-        self._deadline_missed = 0  # failed because the deadline passed
-        self._poison_isolated = 0  # requests isolated as poison by bisection
+        self._created = time.monotonic()
         self._breaker_state = "closed"
-        self._breaker_opens = 0
-        # paged-KV / prefix-reuse ledger (ISSUE-7): every admitted LM
-        # request is one prefix query; a hit means cached prompt pages
-        # were reused and `tokens_saved` prefill steps were skipped
-        self._prefix_queries = 0
-        self._prefix_hits = 0
-        self._prefix_tokens_saved = 0
-        self._pages_in_use = 0     # gauge: KV pages currently refcounted
-        self._pages_free = 0
-        self._pages_total = 0      # 0 = not a paged pool
+
+    def register_into(self, registry: MetricsRegistry,
+                      **labels) -> "ServingMetrics":
+        """Publish every cell on `registry` under `labels` (e.g.
+        ``plane="classifier"``).  Re-registering the same labels (a
+        rolling swap's replacement engine) takes over the series."""
+        for m in (self.requests_total, self.dispatches_total,
+                  self.rows_total, self.padded_rows_total,
+                  self.tokens_total, self.queue_depth_gauge,
+                  self.rejected_total, self.shed_total,
+                  self.deadline_missed_total, self.poison_isolated_total,
+                  self.breaker_state_gauge, self.breaker_opens_total,
+                  self.prefix_queries_total, self.prefix_hits_total,
+                  self.prefix_tokens_saved_total, self.pages_in_use_gauge,
+                  self.pages_free_gauge, self.pages_total_gauge,
+                  self.latency_hist, self.queue_wait_hist,
+                  self.compute_hist):
+            registry.register(m, **labels)
+        return self
 
     # ---- recording --------------------------------------------------------
 
     def _touch(self) -> None:
-        if self._started is None:
-            self._started = time.perf_counter()
+        # unlocked fast path: after the first request this is a single
+        # attribute read per record call (the slow path's lock still
+        # makes the one assignment race-free) — per-record lock traffic
+        # is exactly what the bench obs row's 3% budget polices
+        if self._started is not None:
+            return
+        with self._lock:
+            if self._started is None:
+                self._started = time.perf_counter()
 
     def record_dispatch(self, n_real: int, n_padded: int,
                         queue_depth: Optional[int] = None) -> None:
+        self._touch()
+        self.dispatches_total.inc()
+        self.rows_total.inc(int(n_real))
+        self.padded_rows_total.inc(int(n_padded))
         with self._lock:
-            self._touch()
-            self._dispatches += 1
-            self._rows += int(n_real)
-            self._padded_rows += int(n_padded)
             if queue_depth is not None:  # None = depth owned by the queue
                 self._queue_depth = int(queue_depth)
+                self.queue_depth_gauge.set(queue_depth)
             self._max_occupancy = max(self._max_occupancy, int(n_real))
 
-    def record_request(self, latency_s: float) -> None:
-        with self._lock:
-            self._touch()
-            self._requests += 1
+    def record_request(self, latency_s: float,
+                       queue_wait_s: Optional[float] = None,
+                       compute_s: Optional[float] = None) -> None:
+        """One request served to completion.  `queue_wait_s` (admission
+        to dispatch start) and `compute_s` (dispatch start to end) feed
+        the split histograms when the queue owner knows them."""
+        self._touch()
+        self.requests_total.inc()
         self.latency.record(latency_s)
+        self.latency_hist.observe(latency_s)
+        if queue_wait_s is not None:
+            self.queue_wait_hist.observe(max(0.0, queue_wait_s))
+        if compute_s is not None:
+            self.compute_hist.observe(max(0.0, compute_s))
 
     def record_tokens(self, n: int) -> None:
-        with self._lock:
-            self._touch()
-            self._tokens += int(n)
+        self._touch()
+        self.tokens_total.inc(int(n))
 
     def set_queue_depth(self, depth: int) -> None:
         with self._lock:
             self._queue_depth = int(depth)
+        self.queue_depth_gauge.set(depth)
 
     def record_rejected(self, n: int = 1) -> None:
-        with self._lock:
-            self._touch()
-            self._rejected += int(n)
+        self._touch()
+        self.rejected_total.inc(int(n))
 
     def record_shed(self, n: int = 1) -> None:
-        with self._lock:
-            self._touch()
-            self._shed += int(n)
+        self._touch()
+        self.shed_total.inc(int(n))
 
     def record_deadline_missed(self, n: int = 1) -> None:
-        with self._lock:
-            self._touch()
-            self._deadline_missed += int(n)
+        self._touch()
+        self.deadline_missed_total.inc(int(n))
 
     def record_poison_isolated(self, n: int = 1) -> None:
-        with self._lock:
-            self._touch()
-            self._poison_isolated += int(n)
+        self._touch()
+        self.poison_isolated_total.inc(int(n))
 
     def record_prefix_query(self, tokens_saved: int) -> None:
         """One LM admission's radix-cache outcome: `tokens_saved` prompt
         tokens were served from cached pages (0 = miss)."""
-        with self._lock:
-            self._touch()
-            self._prefix_queries += 1
-            if tokens_saved > 0:
-                self._prefix_hits += 1
-                self._prefix_tokens_saved += int(tokens_saved)
+        self._touch()
+        self.prefix_queries_total.inc()
+        if tokens_saved > 0:
+            self.prefix_hits_total.inc()
+            self.prefix_tokens_saved_total.inc(int(tokens_saved))
 
     def set_pages(self, in_use: int, free: int, total: int) -> None:
-        with self._lock:
-            self._pages_in_use = int(in_use)
-            self._pages_free = int(free)
-            self._pages_total = int(total)
+        self.pages_in_use_gauge.set(in_use)
+        self.pages_free_gauge.set(free)
+        self.pages_total_gauge.set(total)
 
     def set_breaker_state(self, state: str) -> None:
         with self._lock:
             if state == "open" and self._breaker_state != "open":
-                self._breaker_opens += 1
+                self.breaker_opens_total.inc()
             self._breaker_state = str(state)
+        self.breaker_state_gauge.set(_BREAKER_VALUES.get(str(state), 0))
 
     # ---- reading ----------------------------------------------------------
 
     @property
     def dispatches(self) -> int:
-        with self._lock:
-            return self._dispatches
+        return int(self.dispatches_total.value)
 
     @property
     def max_occupancy(self) -> int:
@@ -141,39 +242,50 @@ class ServingMetrics:
         with self._lock:
             elapsed = (time.perf_counter() - self._started
                        if self._started is not None else 0.0)
-            dispatches, requests = self._dispatches, self._requests
-            rows, padded = self._rows, self._padded_rows
-            tokens, depth = self._tokens, self._queue_depth
+            depth = self._queue_depth
             max_occ = self._max_occupancy
-            rejected, shed = self._rejected, self._shed
-            deadline_missed = self._deadline_missed
-            poison = self._poison_isolated
             breaker_state = self._breaker_state
-            breaker_opens = self._breaker_opens
-            pq, ph = self._prefix_queries, self._prefix_hits
-            psaved = self._prefix_tokens_saved
-            pages = (self._pages_in_use, self._pages_free,
-                     self._pages_total)
+            uptime = time.monotonic() - self._created
+        dispatches = int(self.dispatches_total.value)
+        requests = int(self.requests_total.value)
+        rows = int(self.rows_total.value)
+        padded = int(self.padded_rows_total.value)
+        tokens = int(self.tokens_total.value)
+        pq = int(self.prefix_queries_total.value)
         out = {
             "requests": requests,
             "dispatches": dispatches,
             "rows": rows,
             "queue_depth": depth,
-            "rejected": rejected,
-            "shed": shed,
-            "deadline_missed": deadline_missed,
-            "poison_isolated": poison,
+            "rejected": int(self.rejected_total.value),
+            "shed": int(self.shed_total.value),
+            "deadline_missed": int(self.deadline_missed_total.value),
+            "poison_isolated": int(self.poison_isolated_total.value),
             "breaker_state": breaker_state,
-            "breaker_opens": breaker_opens,
+            "breaker_opens": int(self.breaker_opens_total.value),
             "latency": self.latency.summary(),
+            # scrape-friendly timing (ISSUE-8 satellite): rates without
+            # client-side clocks — uptime since construction plus the
+            # monotonic clock this snapshot was cut at
+            "uptime_s": round(uptime, 3),
+            "snapshot_at": time.monotonic(),
         }
+        qw = _ms(self.queue_wait_hist.summary())
+        comp = _ms(self.compute_hist.summary())
+        if qw["count"]:
+            out["queue_wait"] = qw
+        if comp["count"]:
+            out["compute"] = comp
         if pq:
             out["prefix_queries"] = pq
-            out["prefix_hits"] = ph
-            out["prefix_tokens_saved"] = psaved
-            out["prefix_hit_rate"] = round(ph / pq, 3)
-        if pages[2]:
-            out["pages_in_use"], out["pages_free"], out["pages_total"] = pages
+            out["prefix_hits"] = int(self.prefix_hits_total.value)
+            out["prefix_tokens_saved"] = int(
+                self.prefix_tokens_saved_total.value)
+            out["prefix_hit_rate"] = round(out["prefix_hits"] / pq, 3)
+        if int(self.pages_total_gauge.value):
+            out["pages_in_use"] = int(self.pages_in_use_gauge.value)
+            out["pages_free"] = int(self.pages_free_gauge.value)
+            out["pages_total"] = int(self.pages_total_gauge.value)
         if dispatches:
             out["mean_batch_occupancy"] = round(rows / dispatches, 3)
             out["max_batch_occupancy"] = max_occ

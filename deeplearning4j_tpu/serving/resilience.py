@@ -278,11 +278,29 @@ class ServingHTTPMixin:
     def log_message(self, fmt, *args):  # noqa: D102 — silence stderr
         pass
 
+    def request_id(self) -> str:
+        """The request's ``X-Request-Id``: taken from the incoming
+        header when the client (or an upstream fleet router) set one,
+        minted otherwise.  Stored so `_send` echoes it on the response —
+        the client always learns the id its trace is filed under."""
+        rid = getattr(self, "_request_id", None)
+        if rid is None:
+            rid = self.headers.get("X-Request-Id")
+            if not rid:
+                from deeplearning4j_tpu.obs.trace import new_request_id
+
+                rid = new_request_id()
+            self._request_id = str(rid)[:64]
+        return self._request_id
+
     def _send(self, code: int, ctype: str, data: bytes,
               headers: Optional[dict] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        rid = getattr(self, "_request_id", None)
+        if rid is not None:
+            self.send_header("X-Request-Id", rid)
         for k, v in (headers or {}).items():
             self.send_header(k, str(v))
         self.end_headers()

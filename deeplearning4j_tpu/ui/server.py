@@ -38,6 +38,22 @@ Endpoint parity with `UiServer.run():75-87`:
                               serving plane is accepting admissions and
                               no circuit breaker is open; 503 otherwise
                               (drain flips this before traffic stops)
+- GET  /metrics               Prometheus text exposition of every
+                              registered serving plane's metric cells
+                              (requests/dispatches, the resilience
+                              ledger, breaker state, KV page-pool
+                              gauges, latency histograms split into
+                              queue-wait vs compute, compiles_total)
+                              — the observability plane (ISSUE-8,
+                              docs/observability.md)
+- GET  /trace/recent          recent request traces (bounded ring):
+                              queue_wait -> dispatch -> respond spans
+                              per request, xla_compile spans attached
+                              to the request that paid for a compile;
+                              ?format=chrome returns Chrome trace-event
+                              JSON loadable in Perfetto.  Requests may
+                              carry an X-Request-Id header (echoed on
+                              the response; minted when absent)
 
 Serving-plane failures are mapped to transport-correct statuses
 (ISSUE-4): ServingOverloadError/CircuitOpenError -> 503 with a
@@ -64,6 +80,12 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.obs.compilewatch import compile_watcher
+from deeplearning4j_tpu.obs.registry import (
+    EXPOSITION_CONTENT_TYPE,
+    MetricsRegistry,
+)
+from deeplearning4j_tpu.obs.trace import TraceRecorder, chrome_trace
 from deeplearning4j_tpu.serving.resilience import (
     ServingHTTPMixin,
     ServingHTTPServer,
@@ -157,6 +179,16 @@ tick();
 class _UiState:
     def __init__(self):
         self.lock = threading.Lock()
+        # observability plane (ISSUE-8): every serving plane registered
+        # on this server publishes its metric cells here (GET /metrics)
+        # and records request traces here (GET /trace/recent)
+        self.registry = MetricsRegistry()
+        self.tracer = TraceRecorder()
+        self.registry.gauge(
+            "server_uptime_seconds", "seconds since server construction",
+            fn=lambda: self.registry.uptime_s)
+        self.registry.register_collector(
+            compile_watcher().collector_samples)
         self.coords: List[List[float]] = []
         self.tsne_vectors: Optional[np.ndarray] = None
         self.tsne_labels: List[str] = []
@@ -170,6 +202,21 @@ class _UiState:
         self.lm_server = None  # serving.ContinuousLMServer via serve_lm
         self.engine = None     # serving.ServingEngine via serve_model
         self.draining = False  # set by UiServer.begin_drain (SIGTERM path)
+
+    def serving_stats(self) -> dict:
+        """THE /serving/stats payload — one builder for the HTTP
+        endpoint and the host-side drain snapshot, so a field added to
+        one cannot silently miss the other.  `uptime_s` + monotonic
+        `snapshot_at` let scrapers compute rates without client-side
+        clocks (ISSUE-8 satellite)."""
+        import time as _time
+
+        with self.lock:
+            engine, lm_server = self.engine, self.lm_server
+        return {"classifier": engine.stats() if engine else None,
+                "lm": lm_server.stats() if lm_server else None,
+                "uptime_s": round(self.registry.uptime_s, 3),
+                "snapshot_at": _time.monotonic()}
 
 
 class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
@@ -187,8 +234,29 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
     # ---- GET --------------------------------------------------------------
     def do_GET(self) -> None:  # noqa: N802
         s = self.state
-        if self.path in ("/", "/index.html"):
+        path, _, query = self.path.partition("?")
+        if path in ("/", "/index.html"):
             self._html(_DASHBOARD)
+            return
+        if path == "/metrics":
+            # Prometheus text exposition of everything registered on
+            # this server (serving planes, breaker, page pool, compile
+            # counter, uptime) — ISSUE-8
+            self._send(200, EXPOSITION_CONTENT_TYPE,
+                       s.registry.exposition().encode())
+            return
+        if path == "/trace/recent":
+            # recent request traces (bounded ring); ?format=chrome
+            # returns Chrome trace-event JSON (Perfetto-loadable)
+            traces = s.tracer.recent()
+            if "format=chrome" in query:
+                self._json(200, chrome_trace(traces))
+            else:
+                self._json(200, {"traces": traces,
+                                 "recorded": s.tracer.recorded})
+            return
+        if path == "/serving/stats":
+            self._json(200, s.serving_stats())
             return
         if self.path == "/healthz":
             # liveness: answering at all is the signal
@@ -232,11 +300,6 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
                     else None})
             elif self.path == "/activations":
                 self._json(200, {"activations": s.activations})
-            elif self.path == "/serving/stats":
-                engine, lm_server = s.engine, s.lm_server
-                self._json(200, {
-                    "classifier": engine.stats() if engine else None,
-                    "lm": lm_server.stats() if lm_server else None})
             else:
                 self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -350,7 +413,8 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
             try:
                 deadline_s = self._deadline_s(body)
                 x = np.asarray(feats, np.float32)
-                probs = engine.predict_proba(x, deadline_s=deadline_s)
+                probs = engine.predict_proba(x, deadline_s=deadline_s,
+                                             request_id=self.request_id())
             except (ValueError, TypeError) as e:
                 self._json(400, {"error": str(e)})
                 return
@@ -431,7 +495,8 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
                 # whatever else is decoding right now
                 ids = lm_server.generate(ids_list, max_new,
                                          temperature=temperature,
-                                         seed=seed, deadline_s=deadline_s)
+                                         seed=seed, deadline_s=deadline_s,
+                                         request_id=self.request_id())
                 self._json(200, {"ids": ids})
                 return
             import jax
@@ -466,6 +531,16 @@ class UiServer:
     def state(self) -> _UiState:
         return self._server.ui_state  # type: ignore[attr-defined]
 
+    @property
+    def registry(self) -> MetricsRegistry:
+        """The server's metrics registry (rendered at GET /metrics)."""
+        return self.state.registry
+
+    @property
+    def tracer(self) -> TraceRecorder:
+        """The server's trace ring (served at GET /trace/recent)."""
+        return self.state.tracer
+
     def serve_lm(self, cfg, params, slots: int = 4,
                  continuous: bool = True,
                  max_queue_depth: Optional[int] = None,
@@ -499,7 +574,8 @@ class UiServer:
                 cfg, params, slots=slots, max_queue_depth=max_queue_depth,
                 default_deadline_s=default_deadline_s, breaker=breaker,
                 kv=kv, page_size=page_size, pages=pages,
-                prefill_chunk=prefill_chunk)
+                prefill_chunk=prefill_chunk, tracer=self.state.tracer,
+                registry=self.state.registry)
         with self.state.lock:
             self.state.lm = (cfg, params)
             old = self.state.lm_server
@@ -531,7 +607,9 @@ class UiServer:
                                default_deadline_s=default_deadline_s,
                                breaker_threshold=breaker_threshold,
                                breaker_cooldown_s=breaker_cooldown_s,
-                               quantize=quantize)
+                               quantize=quantize,
+                               tracer=self.state.tracer,
+                               registry=self.state.registry)
         if warmup_example is not None:
             engine.warmup(warmup_example)
         with self.state.lock:
@@ -548,11 +626,9 @@ class UiServer:
     # ---- drain lifecycle (the `dl4j serve` SIGTERM path) ------------------
 
     def serving_stats(self) -> dict:
-        """The /serving/stats payload, host-side (drain snapshots it)."""
-        with self.state.lock:
-            engine, lm_server = self.state.engine, self.state.lm_server
-        return {"classifier": engine.stats() if engine else None,
-                "lm": lm_server.stats() if lm_server else None}
+        """The /serving/stats payload, host-side (drain snapshots it) —
+        the same builder the HTTP endpoint serves."""
+        return self.state.serving_stats()
 
     def begin_drain(self) -> None:
         """Stop admission on every registered serving plane: new

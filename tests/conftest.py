@@ -47,6 +47,10 @@ def pytest_configure(config):
         "radix prefix reuse + copy-on-write, chunked prefill, page "
         "refcount ledger under chaos, compile-count guard (fast; run "
         "in tier-1)")
+    config.addinivalue_line(
+        "markers", "obs: observability-plane tests — metrics registry "
+        "+ Prometheus exposition, request tracing across the fleet, "
+        "compile watcher, training telemetry (fast; run in tier-1)")
 
 
 @pytest.fixture

@@ -139,6 +139,8 @@ class TestDocumentIterators:
 
 
 class TestRawTextToRNTN:
+    @pytest.mark.slow  # ~7s end-to-end train; the RNTN quality
+    # gate (test_quality_gates) keeps tier-1 coverage
     def test_rntn_trains_from_raw_sentences(self):
         """VERDICT r1 'done' bar: raw sentences -> trees -> RNTN training
         end to end, loss decreasing."""

@@ -160,6 +160,8 @@ def test_lm_accum_trains_and_generates(tmp_path, capsys):
               "-d-model", "32", "-layers", "1", "-heads", "2"])
 
 
+@pytest.mark.slow  # ~9s; beam/eval semantics are pinned in
+# tests/test_generation.py — this adds only the CLI plumbing
 def test_lm_eval_perplexity_and_beam_generate(tmp_path, capsys):
     """`dl4j lm -eval`: held-out byte perplexity; `-beam k`: beam-search
     decoding from the saved model."""
@@ -268,6 +270,8 @@ def test_lm_mesh_runtimes_match_each_other(tmp_path, capsys):
                                              abs=1e-3)
 
 
+@pytest.mark.slow  # ~8s; MoE dispatch semantics are pinned by
+# TestMoEDispatch in tier-1 — this adds only the CLI flag plumbing
 def test_lm_moe_experts_flag(tmp_path, capsys):
     """-experts trains a Switch-MoE byte LM end-to-end (train -> save ->
     generate), and the pipeline runtime rejects it with the documented
@@ -314,6 +318,8 @@ def test_lm_mesh_layout_factorization():
     assert _lm_mesh_layout("pipeline", 8, 16, 4, 3, 8)[0] == (8, 1)
 
 
+@pytest.mark.slow  # ~18s CLI mesh training; the spmd-runtime CLI
+# train stays in tier-1 (tier-1 870s budget)
 def test_lm_mesh_runtime_single_device(tmp_path, monkeypatch):
     """-runtime pipeline on ONE visible device (the real-chip case) must
     train rather than error."""

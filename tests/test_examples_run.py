@@ -38,6 +38,8 @@ def test_data_parallel_scaling_runs():
     assert loss is not None and np.isfinite(float(loss))
 
 
+@pytest.mark.slow  # ~13s; the long-context kernels keep their own
+# tier-1 coverage in tests/test_kernels.py / test_long_context.py
 def test_long_context_runs():
     loss = _load("long_context").main(steps=2, seq_per_device=16,
                                       d_model=32, n_heads=4, d_ff=64)

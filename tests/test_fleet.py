@@ -256,7 +256,8 @@ class TestFailover:
         router = FleetRouter()
         seen = {}
 
-        def fake_submit(path, body, key=None, timeout=None):
+        def fake_submit(path, body, key=None, timeout=None,
+                        request_id=None):
             seen["path"], seen["body"] = path, body
             return {"ids": [1]}
 
@@ -287,7 +288,8 @@ class TestFailover:
             router.attach(Replica(n, f"http://127.0.0.1:1/{n}"))
         forwarded = []
 
-        def slow_failing_dispatch(replica, path, body, timeout=None):
+        def slow_failing_dispatch(replica, path, body, timeout=None,
+                                  request_id=None):
             forwarded.append(body["deadline_ms"])
             time.sleep(0.05)
             raise _ReplicaDispatchError("boom", replica_fault=True)

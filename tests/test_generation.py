@@ -74,6 +74,8 @@ def test_generate_respects_max_len():
         generate(cfg, params, np.zeros((1, 5), np.int32), 4)
 
 
+@pytest.mark.slow  # ~22s HF golden parity; the cached-vs-recompute
+# and decode-step-vs-full-forward equivalences stay in tier-1
 def test_gpt2_cached_generation_matches_hf():
     torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")

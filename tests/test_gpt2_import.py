@@ -24,6 +24,8 @@ def _tiny_gpt2(seed=0):
     return transformers.GPT2LMHeadModel(cfg).eval()
 
 
+@pytest.mark.slow  # ~22s HF golden forward parity; the import-shape,
+# mesh-sharding and trains-after-import checks stay in tier-1
 def test_logits_match_hf_forward():
     model = _tiny_gpt2()
     cfg, params = import_hf_gpt2(model)

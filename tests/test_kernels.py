@@ -135,6 +135,8 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(flash_logits),
                                    np.asarray(dense_logits), atol=1e-4)
 
+    @pytest.mark.slow  # ~13s full-transformer integration; the
+    # kernel-level flash/ring parities above stay in tier-1
     def test_meshed_transformer_flash_ring_matches_plain_ring(
             self, monkeypatch):
         """With a seq-sharded mesh, forcing flash selects the Pallas ring

@@ -1,0 +1,675 @@
+"""Observability-plane tests (ISSUE-8 acceptance surface).
+
+Covers: the metrics registry (counter/gauge/histogram semantics,
+Prometheus text exposition validity, label escaping, re-registration,
+collectors), ``GET /metrics`` on both serving fronts (serving +
+breaker + page-pool + compile families), the first-class compile
+counter (``compiles_total{program_key=...}`` fed by jax.monitoring,
+surviving ``clear_event_listeners``), request tracing end to end —
+batcher lifecycle spans, xla_compile attribution, Chrome trace-event
+export, ``X-Request-Id`` propagation through the fleet router on
+failover (a killed replica yields ONE trace naming both replicas; ids
+survive 503 retry paths) — the queue-wait vs compute latency split,
+``uptime_s``/``snapshot_at`` on the stats endpoints, and the training
+telemetry listener (step metrics, loss-scale events, supervisor
+interventions, checkpoint-manifest snapshots, `MetricsServer`).
+"""
+
+import json
+import math
+import re
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork, iris_mlp
+from deeplearning4j_tpu.obs import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    MetricsServer,
+    TraceRecorder,
+    TrainingTelemetry,
+    chrome_trace,
+    compile_scope,
+    compile_watcher,
+    new_request_id,
+)
+from deeplearning4j_tpu.serving import (
+    BucketLadder,
+    FleetRouter,
+    ServingEngine,
+    ServingMetrics,
+    spawn_local_replica,
+)
+
+pytestmark = pytest.mark.obs
+
+
+def _mlp(seed=0):
+    return MultiLayerNetwork(iris_mlp()).init(jax.random.PRNGKey(seed))
+
+
+_WARM = np.zeros((4,), np.float32)
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# registry primitives + exposition
+
+
+# One exposition line: HELP/TYPE comment, or name{labels} value.
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\})? '
+    r'(-?[0-9.e+-]+|[+-]Inf|NaN)$')
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_semantics(self):
+        c = Counter("c_total")
+        c.inc()
+        c.inc(2)
+        assert c.value == 3
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        g = Gauge("g")
+        g.set(5)
+        g.inc(-2)
+        assert g.value == 3
+        h = Histogram("h_seconds", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        assert h.count == 3
+        assert h.sum == pytest.approx(5.55)
+        assert h.cumulative() == [(0.1, 1), (1.0, 2), (math.inf, 3)]
+
+    def test_callback_gauge_reads_fn_at_scrape(self):
+        ticks = [0]
+        g = Gauge("uptime", fn=lambda: ticks[0])
+        assert g.value == 0
+        ticks[0] = 7
+        assert g.value == 7
+
+    def test_exposition_is_valid_prometheus_text(self):
+        r = MetricsRegistry()
+        r.counter("req_total", "requests served", plane="classifier").inc(2)
+        r.gauge("depth", 'with "quotes" and \\slashes\\',
+                label='va"l\\ue').set(1.5)
+        r.histogram("lat_seconds", "latency",
+                    buckets=(0.01, 0.1), plane="lm").observe(0.05)
+        text = r.exposition()
+        assert text.endswith("\n")
+        for line in text.strip().split("\n"):
+            if line.startswith("# HELP") or line.startswith("# TYPE"):
+                continue
+            assert _SAMPLE_RE.match(line), f"invalid sample line: {line}"
+        assert 'req_total{plane="classifier"} 2' in text
+        assert '# TYPE req_total counter' in text
+        assert 'lat_seconds_bucket{le="+Inf",plane="lm"} 1' in text
+        assert 'lat_seconds_count{plane="lm"} 1' in text
+        # escaped label value round-trips as an escaped literal
+        assert r'label="va\"l\\ue"' in text
+
+    def test_reregistration_replaces_series(self):
+        """A rolling swap's replacement engine takes over its
+        predecessor's series instead of double-reporting."""
+        r = MetricsRegistry()
+        old = r.counter("req_total", plane="classifier")
+        old.inc(5)
+        new = r.counter("req_total", plane="classifier")
+        new.inc(1)
+        text = r.exposition()
+        assert text.count("req_total{") == 1
+        assert 'req_total{plane="classifier"} 1' in text
+
+    def test_same_name_different_labels_is_one_family(self):
+        r = MetricsRegistry()
+        r.counter("req_total", "reqs", plane="classifier").inc(1)
+        r.counter("req_total", "reqs", plane="lm").inc(2)
+        text = r.exposition()
+        assert text.count("# TYPE req_total counter") == 1
+        assert 'req_total{plane="classifier"} 1' in text
+        assert 'req_total{plane="lm"} 2' in text
+
+    def test_kind_conflict_rejected(self):
+        r = MetricsRegistry()
+        r.counter("thing")
+        with pytest.raises(ValueError, match="already registered"):
+            r.register(Gauge("thing"))
+
+    def test_collector_samples_render(self):
+        r = MetricsRegistry()
+        r.register_collector(lambda: [
+            ("dyn_total", "counter", "dynamic", {"k": "a"}, 3.0)])
+        assert 'dyn_total{k="a"} 3' in r.exposition()
+        assert r.collect()["dyn_total"]["samples"] == [({"k": "a"}, 3.0)]
+
+    def test_histogram_summary_estimates(self):
+        h = Histogram("h", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 3.0, 3.5):
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 4
+        assert s["mean"] == pytest.approx(2.125)
+        assert 0 < s["p50"] <= 2.0
+        assert 2.0 < s["p99"] <= 4.0
+
+
+# ---------------------------------------------------------------------------
+# ServingMetrics on the registry substrate
+
+
+class TestServingMetrics:
+    def test_snapshot_keys_and_clock_fields(self):
+        m = ServingMetrics()
+        m.record_dispatch(3, 8)
+        m.record_request(0.05, queue_wait_s=0.01, compute_s=0.04)
+        s1 = m.snapshot()
+        for key in ("requests", "dispatches", "rows", "queue_depth",
+                    "rejected", "shed", "deadline_missed",
+                    "poison_isolated", "breaker_state", "breaker_opens",
+                    "latency", "uptime_s", "snapshot_at"):
+            assert key in s1, key
+        assert s1["requests"] == 1 and s1["rows"] == 3
+        # the new latency split (satellite): queue-wait vs compute
+        assert s1["queue_wait"]["count"] == 1
+        assert s1["compute"]["count"] == 1
+        assert s1["compute"]["mean_ms"] == pytest.approx(40.0, rel=0.3)
+        s2 = m.snapshot()
+        assert s2["snapshot_at"] > s1["snapshot_at"]   # monotonic
+        assert s2["uptime_s"] >= s1["uptime_s"]
+
+    def test_register_into_publishes_on_registry(self):
+        m = ServingMetrics()
+        r = MetricsRegistry()
+        m.register_into(r, plane="classifier")
+        m.record_request(0.01)
+        m.record_rejected()
+        m.set_breaker_state("open")
+        text = r.exposition()
+        assert 'serving_requests_total{plane="classifier"} 1' in text
+        assert 'serving_rejected_total{plane="classifier"} 1' in text
+        assert 'serving_breaker_state{plane="classifier"} 1' in text
+        assert 'serving_breaker_opens_total{plane="classifier"} 1' in text
+        assert "serving_kv_pages_total" in text
+        # the stats endpoint reads the SAME cells
+        assert m.snapshot()["breaker_state"] == "open"
+
+
+# ---------------------------------------------------------------------------
+# compile watcher
+
+
+class TestCompileWatcher:
+    def test_scoped_compile_counts_under_program_key(self):
+        w = compile_watcher()
+        key = f"test:{new_request_id()}"      # unique per run
+        before = w.total(prefix=key)
+        with compile_scope(key):
+            # a shape/closure no other test compiles
+            jax.jit(lambda x: x * 3.13579 + 1)(
+                np.zeros((3, 5), np.float32))
+        assert w.total(prefix=key) == before + 1
+        assert w.counts()[key] >= 1
+        # the event ring attributes it in time
+        events = w.events_between(0.0, float("inf"))
+        assert any(k == key for _, _, k in events)
+
+    def test_survives_clear_event_listeners(self):
+        import jax.monitoring
+
+        w = compile_watcher()
+        jax.monitoring.clear_event_listeners()
+        w2 = compile_watcher()                 # re-installs
+        assert w2 is w
+        key = f"test:{new_request_id()}"
+        with compile_scope(key):
+            jax.jit(lambda x: x - 2.71828)(np.zeros((2, 7), np.float32))
+        assert w.total(prefix=key) == 1
+
+    def test_collector_samples_expose_compiles_total(self):
+        w = compile_watcher()
+        key = f"test:{new_request_id()}"
+        with compile_scope(key):
+            jax.jit(lambda x: x / 1.41421)(np.zeros((4, 2), np.float32))
+        samples = list(w.collector_samples())
+        names = {s[0] for s in samples}
+        assert "compiles_total" in names
+        assert "compile_seconds_total" in names
+        assert any(s[3].get("program_key") == key and s[4] >= 1
+                   for s in samples if s[0] == "compiles_total")
+
+
+# ---------------------------------------------------------------------------
+# engine + batcher tracing
+
+
+class TestEngineTracing:
+    def test_request_trace_spans_and_request_id(self):
+        tracer = TraceRecorder()
+        engine = ServingEngine(_mlp(), ladder=BucketLadder((1, 8)),
+                               max_wait_ms=1.0, tracer=tracer)
+        engine.warmup(_WARM)
+        try:
+            engine.predict_proba(np.zeros((2, 4), np.float32),
+                                 timeout=30, request_id="rid-1")
+        finally:
+            engine.stop()
+        traces = tracer.find("rid-1")
+        assert len(traces) == 1
+        names = [s["name"] for s in traces[0]["spans"]]
+        assert names[:3] == ["queue_wait", "dispatch", "respond"]
+        assert traces[0]["status"] == "ok"
+        # warmed path: no xla_compile span rode this request
+        assert "xla_compile" not in names
+
+    def test_unwarmed_request_carries_xla_compile_span(self):
+        """The off-ladder-recompile story: a request that triggers a
+        compile gets an xla_compile span in ITS trace."""
+        tracer = TraceRecorder()
+        engine = ServingEngine(_mlp(seed=3), ladder=BucketLadder((1, 4)),
+                               max_wait_ms=1.0, tracer=tracer)
+        try:
+            engine.predict_proba(np.zeros((2, 4), np.float32),
+                                 timeout=60, request_id="rid-cold")
+        finally:
+            engine.stop()
+        (tr,) = tracer.find("rid-cold")
+        compiles = [s for s in tr["spans"] if s["name"] == "xla_compile"]
+        assert compiles, tr["spans"]
+        assert any("classifier:" in s["attrs"].get("program_key", "")
+                   for s in compiles)
+
+    def test_stats_report_compiles_total(self):
+        engine = ServingEngine(_mlp(), ladder=BucketLadder((1, 8)),
+                               max_wait_ms=1.0)
+        engine.warmup(_WARM)
+        stats = engine.stats()
+        engine.stop()
+        assert stats["compiles_total"] >= stats["compiled_programs"] > 0
+
+    def test_minted_id_when_client_sends_none(self):
+        tracer = TraceRecorder()
+        engine = ServingEngine(_mlp(), ladder=BucketLadder((1, 8)),
+                               max_wait_ms=1.0, tracer=tracer)
+        engine.warmup(_WARM)
+        try:
+            engine.predict_proba(np.zeros((1, 4), np.float32), timeout=30)
+        finally:
+            engine.stop()
+        (tr,) = tracer.recent(1)
+        assert len(tr["request_id"]) >= 16
+
+    def test_chrome_export_is_loadable_events(self):
+        tracer = TraceRecorder()
+        engine = ServingEngine(_mlp(), ladder=BucketLadder((1, 8)),
+                               max_wait_ms=1.0, tracer=tracer)
+        engine.warmup(_WARM)
+        try:
+            engine.predict_proba(np.zeros((1, 4), np.float32), timeout=30)
+        finally:
+            engine.stop()
+        events = chrome_trace(tracer.recent())
+        assert events and json.loads(json.dumps(events)) == events
+        for ev in events:
+            assert ev["ph"] == "X"
+            assert {"name", "ts", "dur", "pid", "tid"} <= set(ev)
+
+
+# ---------------------------------------------------------------------------
+# UI server endpoints
+
+
+class TestUiServerEndpoints:
+    def _server(self):
+        from deeplearning4j_tpu.ui.server import UiServer
+
+        srv = UiServer(port=0)
+        srv.serve_model(_mlp(), ladder=BucketLadder((1, 8)), max_batch=8,
+                        max_wait_ms=1.0, warmup_example=_WARM)
+        return srv.start()
+
+    def test_metrics_endpoint_exposes_families(self):
+        srv = self._server()
+        try:
+            _post(srv.url + "/model/predict",
+                  {"features": [[0.1, 0.2, 0.3, 0.4]]})
+            status, headers, body = _get(srv.url + "/metrics")
+        finally:
+            srv.stop()
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        text = body.decode()
+        assert 'serving_requests_total{plane="classifier"} 1' in text
+        assert "serving_breaker_state" in text
+        assert "serving_kv_pages_total" in text
+        assert "serving_queue_wait_seconds_bucket" in text
+        assert "compiles_total" in text
+        assert "server_uptime_seconds" in text
+
+    def test_request_id_roundtrip_and_trace_recent(self):
+        srv = self._server()
+        try:
+            status, headers, _ = _post(
+                srv.url + "/model/predict",
+                {"features": [[0.1, 0.2, 0.3, 0.4]]},
+                headers={"X-Request-Id": "client-id-42"})
+            assert status == 200
+            assert headers["X-Request-Id"] == "client-id-42"
+            _, _, body = _get(srv.url + "/trace/recent")
+            payload = json.loads(body)
+            ids = [t["request_id"] for t in payload["traces"]]
+            assert "client-id-42" in ids
+            _, _, body = _get(srv.url + "/trace/recent?format=chrome")
+            events = json.loads(body)
+            assert isinstance(events, list) and events
+            assert all(ev["ph"] == "X" for ev in events)
+        finally:
+            srv.stop()
+
+    def test_serving_stats_carries_clock_fields(self):
+        srv = self._server()
+        try:
+            _, _, body = _get(srv.url + "/serving/stats")
+            payload = json.loads(body)
+        finally:
+            srv.stop()
+        assert payload["uptime_s"] >= 0
+        assert "snapshot_at" in payload
+        assert "uptime_s" in payload["classifier"]
+
+
+# ---------------------------------------------------------------------------
+# LM pool tracing
+
+
+class TestLMTracing:
+    def test_generate_trace_has_queue_and_decode_spans(self):
+        from deeplearning4j_tpu.parallel import transformer as tfm
+        from deeplearning4j_tpu.serving import ContinuousLMServer
+
+        cfg = tfm.TransformerConfig(vocab_size=50, d_model=16, n_heads=2,
+                                    n_layers=1, d_ff=32, max_len=24)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        tracer = TraceRecorder()
+        registry = MetricsRegistry()
+        srv = ContinuousLMServer(cfg, params, slots=2, tracer=tracer,
+                                 registry=registry)
+        try:
+            out = srv.generate([1, 2, 3], 4, request_id="lm-rid")
+            stats = srv.stats()
+        finally:
+            srv.stop()
+        assert len(out) == 7
+        (tr,) = tracer.find("lm-rid")
+        names = [s["name"] for s in tr["spans"]]
+        assert "queue_wait" in names and "decode" in names
+        decode = next(s for s in tr["spans"] if s["name"] == "decode")
+        assert decode["attrs"]["generated"] == 4
+        assert stats["compiles_total"] >= 1
+        assert stats["queue_wait"]["count"] == 1
+        assert stats["compute"]["count"] == 1
+        assert 'serving_tokens_total{plane="lm"}' in registry.exposition()
+
+
+# ---------------------------------------------------------------------------
+# fleet: trace propagation across failover (satellite)
+
+
+@pytest.mark.fleet
+class TestFleetTracePropagation:
+    def _router(self, net):
+        router = FleetRouter(request_timeout_s=30.0)
+        for name in ("a", "b"):
+            router.attach(spawn_local_replica(
+                name, net, ladder=BucketLadder((1, 8)), max_wait_ms=1.0,
+                warmup_example=_WARM))
+        return router
+
+    def test_killed_replica_yields_one_trace_spanning_both(self):
+        """ISSUE-8 acceptance: a chaos-killed replica produces a SINGLE
+        trace with a failover hop span naming the corpse and a
+        successful dispatch on the survivor."""
+        net = _mlp()
+        router = self._router(net)
+        try:
+            # kill whichever replica the router would pick first, so
+            # the request deterministically hits the corpse then fails
+            # over (least-loaded tie breaks by name -> "a")
+            victim = next(r for r in router.replicas() if r.name == "a")
+            victim.kill()
+            out = router.predict_proba(np.zeros((1, 4), np.float32),
+                                       request_id="storm-rid")
+        finally:
+            router.stop()
+        assert out.shape == (1, 3)
+        traces = router.tracer.find("storm-rid")
+        assert len(traces) == 1                      # ONE trace
+        tr = traces[0]
+        assert tr["status"] == "ok"
+        dispatches = [s for s in tr["spans"] if s["name"] == "dispatch"]
+        replicas = [s["attrs"]["replica"] for s in dispatches]
+        assert replicas == ["a", "b"]                # both replicas named
+        outcomes = [s["attrs"]["outcome"] for s in dispatches]
+        assert outcomes == ["fault", "ok"]
+        hops = [s for s in tr["spans"] if s["name"] == "failover_hop"]
+        assert len(hops) == 1 and hops[0]["attrs"]["excluded"] == "a"
+        assert tr["attrs"]["failovers"] == 1
+
+    def test_request_id_survives_503_retry_path(self):
+        """A draining replica answers 503; the router fails over
+        penalty-free and the SAME request id reaches the survivor —
+        whose own serving plane traced it too."""
+        net = _mlp()
+        router = self._router(net)
+        try:
+            draining = next(r for r in router.replicas()
+                            if r.name == "a")
+            draining.server.begin_drain()
+            out = router.predict_proba(np.zeros((1, 4), np.float32),
+                                       request_id="retry-rid")
+            survivor = next(r for r in router.replicas()
+                            if r.name == "b")
+            replica_ids = [t["request_id"]
+                           for t in survivor.server.tracer.recent()]
+            breaker_state = draining.breaker.state
+        finally:
+            router.stop()
+        assert out.shape == (1, 3)
+        (tr,) = router.tracer.find("retry-rid")
+        dispatches = [s for s in tr["spans"] if s["name"] == "dispatch"]
+        assert [s["attrs"]["outcome"] for s in dispatches] == [
+            "unavailable", "ok"]
+        # the id propagated INTO the surviving replica's own trace ring
+        assert "retry-rid" in replica_ids
+        # 503 is penalty-free: the draining replica's breaker stays closed
+        assert breaker_state == "closed"
+
+    def test_fleet_front_metrics_and_trace_endpoints(self):
+        net = _mlp()
+        from deeplearning4j_tpu.serving import FleetServer
+
+        router = self._router(net)
+        front = FleetServer(router, port=0).start()
+        try:
+            status, headers, _ = _post(
+                front.url + "/model/predict",
+                {"features": [[0.0, 0.1, 0.2, 0.3]]},
+                headers={"X-Request-Id": "front-rid"})
+            assert status == 200
+            assert headers["X-Request-Id"] == "front-rid"
+            _, mh, body = _get(front.url + "/metrics")
+            text = body.decode()
+            assert mh["Content-Type"].startswith("text/plain")
+            assert 'serving_requests_total{plane="fleet"} 1' in text
+            assert 'fleet_replica_in_flight{replica="a"}' in text
+            assert "fleet_replica_breaker_state" in text
+            assert "serving_kv_pages_total" in text
+            assert "compiles_total" in text
+            _, _, body = _get(front.url + "/trace/recent")
+            ids = [t["request_id"]
+                   for t in json.loads(body)["traces"]]
+            assert "front-rid" in ids
+            # /fleet/stats carries the scrape clock fields (satellite)
+            _, _, body = _get(front.url + "/fleet/stats")
+            fleet = json.loads(body)["fleet"]
+            assert "uptime_s" in fleet and "snapshot_at" in fleet
+        finally:
+            front.stop()
+
+
+# ---------------------------------------------------------------------------
+# training telemetry
+
+
+class TestTrainingTelemetry:
+    def test_listener_feeds_step_metrics(self):
+        registry = MetricsRegistry()
+        telemetry = TrainingTelemetry(registry=registry, sync_interval=1,
+                                      batch_size=8)
+        net = _mlp()
+        net.add_listener(telemetry)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        for _ in range(5):
+            net.fit_batch(x, y)
+        assert telemetry.steps_total.value == 5
+        assert telemetry.loss.value > 0
+        assert telemetry.grad_norm.value > 0
+        assert telemetry.examples_per_sec.value > 0
+        text = registry.exposition()
+        assert 'train_steps_total{job="train"} 5' in text
+        assert "train_step_seconds_bucket" in text
+        snap = telemetry.snapshot()
+        assert snap["steps"] == 5 and snap["examples_per_sec"] > 0
+
+    def test_chunked_fit_fires_at_chunk_boundaries_only(self):
+        """Chunk-aware: a model-reading listener must not force
+        off-boundary host syncs — it fires once per chunk."""
+        telemetry = TrainingTelemetry(sync_interval=1, batch_size=8)
+        net = _mlp()
+        net.add_listener(telemetry)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(32, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        batches = [(x[i:i + 8], y[i:i + 8]) for i in range(0, 32, 8)]
+        net.fit(iter(batches), chunk_size=4)
+        # 4 steps ran; the listener observed the chunk's final step and
+        # counted the whole chunk's step delta
+        assert telemetry.steps_total.value == 4
+
+    def test_loss_scale_grow_and_backoff_events(self):
+        telemetry = TrainingTelemetry()
+        telemetry.observe_scaler({"scale": 1024.0, "overflow_count": 0})
+        telemetry.observe_scaler({"scale": 2048.0, "overflow_count": 0})
+        telemetry.observe_scaler({"scale": 1024.0, "overflow_count": 1})
+        assert telemetry.loss_scale.value == 1024.0
+        assert telemetry.loss_scale_grow.value == 1
+        assert telemetry.loss_scale_backoff.value == 1
+        snap = telemetry.snapshot()
+        assert snap["loss_scale_grows"] == 1
+        assert snap["loss_scale_backoffs"] == 1
+
+    def test_supervisor_interventions_and_manifest_snapshot(self, tmp_path):
+        from deeplearning4j_tpu.resilience import (
+            ResilienceConfig,
+            TrainingSupervisor,
+        )
+
+        telemetry = TrainingTelemetry(sync_interval=1, batch_size=8)
+        net = _mlp()
+        net.add_listener(telemetry)
+        sup = TrainingSupervisor(net, ResilienceConfig(
+            checkpoint_dir=tmp_path / "ckpts", checkpoint_every=4,
+            min_history=3), telemetry=telemetry)
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        poison = np.full_like(x, np.nan)
+        batches = [(x, y)] * 3 + [(poison, y)] + [(x, y)] * 5
+        report = sup.run(iter(batches))
+        assert report.skipped == 1
+        assert telemetry.interventions["poison_skip"].value == 1
+        assert telemetry.interventions["checkpoint"].value >= 1
+        # the checkpoint manifest embeds the telemetry snapshot
+        metas = sorted((tmp_path / "ckpts").glob("ckpt-*/meta.json"))
+        extra = json.loads(metas[-1].read_text())["extra"]
+        assert extra["telemetry"]["steps"] == report.steps
+        assert extra["telemetry"]["interventions"]["poison_skip"] == 1
+
+    def test_metrics_server_scrapes(self):
+        registry = MetricsRegistry()
+        registry.counter("scraped_total", "x").inc(9)
+        srv = MetricsServer(registry, port=0).start()
+        try:
+            status, headers, body = _get(srv.url + "/metrics")
+            assert status == 200
+            assert headers["Content-Type"].startswith("text/plain")
+            assert "scraped_total 9" in body.decode()
+            status, _, _ = _get(srv.url + "/healthz")
+            assert status == 200
+        finally:
+            srv.stop()
+
+    def test_cli_train_parser_has_metrics_flags(self):
+        from deeplearning4j_tpu.cli import build_parser
+
+        args = build_parser().parse_args(
+            ["train", "-input", "x", "-model", "y",
+             "-metrics-port", "0", "-metrics-interval", "5"])
+        assert args.metrics_port == 0
+        assert args.metrics_interval == 5
+
+
+# ---------------------------------------------------------------------------
+# trace recorder mechanics
+
+
+class TestTraceRecorder:
+    def test_ring_is_bounded_and_lazy_entries_materialize(self):
+        rec = TraceRecorder(capacity=3)
+        for i in range(5):
+            rec.record({"request_id": f"r{i}", "kind": "t", "status": "ok",
+                        "t0_s": float(i), "dur_s": 0.0, "spans": []})
+        out = rec.recent()
+        assert [t["request_id"] for t in out] == ["r2", "r3", "r4"]
+        assert rec.recorded == 5
+        rec.record_lazy(lambda raw: {"request_id": raw, "spans": []},
+                        "lazy-1")
+        assert rec.recent()[-1]["request_id"] == "lazy-1"
+        assert rec.find("lazy-1")
+
+    def test_ids_are_unique_under_threads(self):
+        ids = []
+        lock = threading.Lock()
+
+        def mint():
+            local = [new_request_id() for _ in range(200)]
+            with lock:
+                ids.extend(local)
+
+        threads = [threading.Thread(target=mint) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(ids)) == len(ids)

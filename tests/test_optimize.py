@@ -155,6 +155,7 @@ class TestSolverDriver:
         acc = (net.predict(x) == labels).mean()
         assert acc > 0.9
 
+    @pytest.mark.slow  # ~11s: compiles ten shapes by design
     def test_solver_fit_warns_on_many_batch_shapes_keeps_cache(self):
         """Ragged batch streams under a line-search solver warn once past
         the shape-cache guard but RETAIN every compiled step (no eviction:

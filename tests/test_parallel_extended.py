@@ -238,6 +238,8 @@ class TestMoEDispatch:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4)
 
+    @pytest.mark.slow  # ~16s full-model MoE train+decode; the
+    # dispatch-vs-dense-oracle equivalences above stay in tier-1
     def test_top2_full_model_trains_and_decodes_consistently(self):
         """moe_top_k=2 end to end: lm_loss trains (finite, decreasing)
         and the decode contract holds (dense top-2 inference both
@@ -324,6 +326,8 @@ class TestMoEDispatch:
         np.testing.assert_allclose(np.asarray(sharded), np.asarray(single),
                                    atol=2e-5)
 
+    @pytest.mark.slow  # ~10s; the dense-oracle dispatch parities
+    # above keep MoE routing covered in tier-1
     def test_inference_apply_is_dense_and_matches_decode_contract(self):
         """apply()'s inference default must be batch-composition-independent
         (dense MoE, no drops): scoring one sequence alone equals scoring it
@@ -426,7 +430,11 @@ def _single_device_steps(cfg, tokens, targets, lr, n_steps, seed):
 
 
 class TestHybridParallelTrainer:
-    @pytest.mark.parametrize("n_experts", [0, 4])
+    # the MoE variant (~23s) rides the slow lane: expert dispatch
+    # equivalence is pinned by TestMoEDispatch's dense-oracle tests in
+    # tier-1, and the dense hybrid A/B stays here (tier-1 870s budget)
+    @pytest.mark.parametrize("n_experts", [
+        0, pytest.param(4, marks=pytest.mark.slow)])
     def test_matches_single_device(self, n_experts):
         cfg = tfm.TransformerConfig(
             vocab_size=61, d_model=16, n_heads=4, n_layers=2, d_ff=32,
@@ -453,6 +461,8 @@ class TestHybridParallelTrainer:
         for a, b_ in zip(flat_g, flat_w):
             np.testing.assert_allclose(a, b_, atol=5e-4)
 
+    @pytest.mark.slow  # ~6s; the single-device A/B above is the
+    # stronger hybrid-trainer gate and stays in tier-1
     def test_loss_decreases(self):
         cfg = tfm.TransformerConfig(vocab_size=31, d_model=16, n_heads=2,
                                     n_layers=1, d_ff=32, max_len=16)
@@ -507,6 +517,8 @@ class TestFlagshipTrainingPath:
         loss0 = float(tfm.lm_loss(cfg, params, tokens, targets))
         assert loss0 < 2.0 * np.log(cfg.vocab_size), loss0
 
+    @pytest.mark.slow  # ~13s; grad-accumulation equivalence keeps
+    # the flagship training path covered in tier-1
     def test_remat_is_numerically_transparent(self):
         tokens = jnp.asarray(
             np.random.default_rng(1).integers(0, 64, (2, 8)), jnp.int32)
@@ -687,7 +699,11 @@ class TestGPipeMemoryHygiene:
 
 
 class TestPipelineParallelTrainer:
-    @pytest.mark.parametrize("tied", [False, True])
+    # untied (~21s) rides the slow lane; the TIED config stays in
+    # tier-1 — it is the flagship gpt2_small shape and additionally
+    # proves the stage-psum on the doubly-contributed embed leaf
+    @pytest.mark.parametrize("tied", [
+        pytest.param(False, marks=pytest.mark.slow), True])
     def test_matches_single_device(self, tied):
         """Untied AND tied (GPT-2-style) configs: under tying the embed
         leaf receives two gradient contributions (lookup + lm-head
@@ -731,6 +747,8 @@ class TestPipelineParallelTrainer:
         np.testing.assert_allclose(got_w1, want_w1, atol=5e-4)
 
 
+@pytest.mark.slow  # ~16s mesh bf16 A/B; the precision plane's own
+# mixed-parity suite (tests/test_precision.py) stays in tier-1
 def test_bf16_compute_keeps_f32_master_params():
     """Mixed-precision contract for the hybrid trainers: with a bf16
     config the parameters live (and update) in float32 — a pure-bf16

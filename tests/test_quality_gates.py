@@ -77,6 +77,9 @@ class TestCharLmGate:
         assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow  # ~20s class fixture trains w2v on a real
+# corpus; w2v training/convergence keep tier-1 coverage in
+# tests/test_w2v_*.py (tier-1 870s budget)
 class TestWord2VecSimilarityGate:
     """Word2Vec trained on a real English corpus must place related words
     closer than random pairs (reference Word2VecTests train on a bundled
@@ -183,6 +186,8 @@ class TestRntnSentimentGate:
             te += [grp[i] for i in idx[k:]]
         return tr, te
 
+    @pytest.mark.slow  # ~15s held-out train; RNTN mechanics keep
+    # tier-1 coverage in tests/test_rntn.py
     def test_rntn_beats_majority_on_held_out_roots(self):
         from deeplearning4j_tpu.models.rntn import RNTN, RNTNEval
         from deeplearning4j_tpu.nlp.sentiment import sentiment_trees
@@ -254,6 +259,8 @@ class TestTransformerLmGate:
     """The flagship TransformerLM must actually learn real English text:
     byte-level LM on this repo's docs, loss must drop substantially."""
 
+    @pytest.mark.slow  # ~14s; the CLI lm train+generate e2e
+    # (tests/test_cli.py) keeps a loss-bearing LM train in tier-1
     def test_transformer_lm_loss_decreases(self):
         import jax
         import jax.numpy as jnp

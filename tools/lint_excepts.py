@@ -46,6 +46,19 @@ SERVING_ALLOWLIST: dict = {
 }
 SERVING_PREFIX = "deeplearning4j_tpu/serving/"
 
+# The observability plane gets the same strict bar (ISSUE-8): a
+# swallowed exception inside a metrics/trace hook silently blinds the
+# system right when something is going wrong — no broad handlers at
+# all, pragma'd or not.
+OBS_ALLOWLIST: dict = {}
+OBS_PREFIX = "deeplearning4j_tpu/obs/"
+
+# prefix -> (allowlist, label) for the strict-mode passes
+STRICT_PREFIXES = (
+    (SERVING_PREFIX, SERVING_ALLOWLIST, "SERVING_ALLOWLIST"),
+    (OBS_PREFIX, OBS_ALLOWLIST, "OBS_ALLOWLIST"),
+)
+
 PACKAGE = "deeplearning4j_tpu"
 PRAGMA = "noqa: BLE001"
 
@@ -93,20 +106,23 @@ def main(argv=None) -> int:
     failures = []
     for path in sorted(pkg.rglob("*.py")):
         rel = str(path.relative_to(root))
-        if rel.startswith(SERVING_PREFIX):
+        strict = next(((allow, label)
+                       for prefix, allow, label in STRICT_PREFIXES
+                       if rel.startswith(prefix)), None)
+        if strict is not None:
             # strict mode subsumes the relaxed pragma check: count EVERY
             # broad handler (pragma'd or not) against the explicit
-            # serving allowlist ceiling, and report each offender once
+            # allowlist ceiling, and report each offender once
+            allow, label = strict
             every = list(broad_handlers(path, respect_pragma=False))
-            ceiling = SERVING_ALLOWLIST.get(rel, 0)
+            ceiling = allow.get(rel, 0)
             if len(every) > ceiling:
                 for lineno, line in every[ceiling:]:
                     failures.append(
-                        f"{rel}:{lineno}: broad except handler under "
-                        f"serving/ exceeds the SERVING_ALLOWLIST ceiling "
-                        f"({ceiling}) — narrow it or (if it really is a "
-                        f"group-failure isolator) raise the ceiling with "
-                        f"a review: {line}")
+                        f"{rel}:{lineno}: broad except handler exceeds "
+                        f"the {label} ceiling ({ceiling}) — narrow it "
+                        f"or (if it really is a group-failure isolator) "
+                        f"raise the ceiling with a review: {line}")
             continue
         found = list(broad_handlers(path))
         allowed = ALLOWLIST.get(rel, 0)

@@ -1,9 +1,10 @@
 # Convenience entry points. Everything here is reproducible by hand —
 # the targets just spell the one-liners out.
 
-.PHONY: test test-serving test-precision test-fleet test-paged dryrun \
-	bench smoke serving-smoke bench-precision bench-fleet bench-paged \
-	test-obs bench-obs obs-smoke evidence lint
+.PHONY: test test-serving test-precision test-fleet test-paged \
+	test-procfleet dryrun bench smoke serving-smoke bench-precision \
+	bench-fleet bench-paged bench-procfleet test-obs bench-obs \
+	obs-smoke evidence lint
 
 test:
 	python -m pytest tests/ -x -q
@@ -21,6 +22,17 @@ test-fleet:
 # (requests/s, p99, failed must be 0) + the shared-prefix LM leg.
 bench-fleet:
 	BENCH_ONLY=servingfleet python bench.py
+
+# Process-supervision only (crash detection/classification, backoff
+# restart, crash-loop quarantine, cross-host attach, launcher
+# spawn/reap/log hygiene — real processes via the stdlib stub worker).
+test-procfleet:
+	python -m pytest tests/ -q -m procfleet
+
+# Process-supervision bench row: 3 REAL `dl4j serve` worker processes,
+# one SIGKILL'd mid-storm — failed must be 0, restart latency reported.
+bench-procfleet:
+	BENCH_ONLY=procfleet python bench.py
 
 # Paged-KV tests only (block-table pool parity, radix prefix reuse +
 # copy-on-write, chunked prefill, page refcount ledger under chaos,

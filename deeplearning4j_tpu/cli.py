@@ -539,31 +539,38 @@ def cmd_serve_fleet(args) -> int:
     `dl4j serve`-equivalent replicas behind a `FleetRouter` (least-loaded
     + failover dispatch, /readyz-driven health ejection with half-open
     re-admission, optional queue-depth autoscale) fronted by one
-    `FleetServer` endpoint.  SIGTERM drains the WHOLE fleet gracefully
-    and snapshots /fleet/stats (deeplearning4j_tpu/serving/fleet.py;
-    docs/robustness.md "The serving fleet")."""
+    `FleetServer` endpoint.  With `-processes`, each replica is instead
+    a real spawned `dl4j serve` worker process supervised end-to-end —
+    crash detection, backoff restart, crash-loop quarantine
+    (serving/procfleet.py; docs/robustness.md "Process supervision").
+    SIGTERM drains the WHOLE fleet gracefully and snapshots /fleet/stats
+    (deeplearning4j_tpu/serving/fleet.py; docs/robustness.md "The
+    serving fleet")."""
     import signal
     import threading
 
-    from deeplearning4j_tpu.nn.conf import DenseLayerConf
-    from deeplearning4j_tpu.serving import (
-        BucketLadder,
-        FleetRouter,
-        FleetServer,
-        spawn_local_replica,
-    )
+    from deeplearning4j_tpu.serving import FleetRouter, FleetServer
 
     if not args.model:
         raise SystemExit("serve-fleet needs -model")
     if args.replicas < 1:
         raise SystemExit(f"-replicas must be >= 1, got {args.replicas}")
-    net = _build_net(args.model)
-    buckets = tuple(int(b) for b in args.buckets.split(","))
     max_queue = args.max_queue if args.max_queue > 0 else None
     deadline_s = args.deadline_ms / 1e3 if args.deadline_ms > 0 else None
     breaker_n = (args.breaker_threshold if args.breaker_threshold > 0
                  else None)
     quantize = args.quantize if args.quantize != "none" else None
+
+    if args.processes:
+        return _serve_fleet_processes(args, max_queue=max_queue,
+                                      breaker_n=breaker_n,
+                                      quantize=quantize)
+
+    from deeplearning4j_tpu.nn.conf import DenseLayerConf
+    from deeplearning4j_tpu.serving import BucketLadder, spawn_local_replica
+
+    net = _build_net(args.model)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
     first = net.conf.layers[0]
     # same flat-input rule as cmd_serve: a [b, n_in] warmup batch only
     # makes sense for dense stacks
@@ -635,6 +642,121 @@ def cmd_serve_fleet(args) -> int:
             print(f"serve-fleet: drain "
                   f"{'complete' if drained else 'grace expired'}; stats "
                   f"snapshot -> {where}")
+        front.stop()
+        if installed:
+            signal.signal(signal.SIGTERM, prev)
+    return 0
+
+
+def _serve_fleet_processes(args, *, max_queue, breaker_n, quantize) -> int:
+    """`serve-fleet -processes`: each replica is a real spawned
+    `dl4j serve` worker process on `worker-base-port + i`, supervised
+    end-to-end by a `FleetSupervisor` — crash detection (exit status +
+    /readyz), exponential-backoff restart with warm-then-attach
+    re-admission, crash-loop quarantine — behind the same `FleetServer`
+    front.  The parent stays model-free: the model string (dir / conf /
+    zoo:) passes straight through to the worker command lines, so this
+    process never pays the jax model build."""
+    import signal
+    import threading
+
+    from deeplearning4j_tpu.runtime.launcher import FleetProcessLauncher
+    from deeplearning4j_tpu.serving import FleetRouter, FleetServer
+    from deeplearning4j_tpu.serving.procfleet import (
+        FleetSupervisor,
+        RestartPolicy,
+    )
+
+    if args.autoscale:
+        print("serve-fleet: -autoscale ignored with -processes (worker "
+              "count is the launcher's; scale by respawning with more "
+              "replicas)")
+    launcher = FleetProcessLauncher(
+        args.model, n_replicas=args.replicas, host=args.host,
+        base_port=args.worker_base_port, buckets=args.buckets,
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        warmup=args.warmup, max_queue=max_queue,
+        deadline_ms=(args.deadline_ms if args.deadline_ms > 0 else None),
+        breaker_threshold=breaker_n, quantize=quantize,
+        log_dir=args.worker_log_dir)
+    router = FleetRouter(health_interval_s=args.health_interval_s)
+    supervisor = FleetSupervisor(
+        router,
+        policy=RestartPolicy(
+            backoff_initial_s=args.restart_backoff_s,
+            crash_loop_threshold=args.crash_loop_threshold,
+            crash_loop_window_s=args.crash_loop_window_s),
+        poll_interval_s=args.health_interval_s,
+        ready_timeout_s=args.ready_timeout_s)
+    supervisor.manage_launcher(launcher)
+    supervisor.start()
+    print(f"serve-fleet: spawned {args.replicas} worker process(es) on "
+          f"ports {launcher.port(0)}..{launcher.port(args.replicas - 1)} "
+          f"(logs under {launcher.log_dir}); waiting for /readyz "
+          f"(timeout {args.ready_timeout_s}s)")
+    try:
+        ready = supervisor.wait_all_ready(args.ready_timeout_s)
+        states = {n: w["state"]
+                  for n, w in supervisor.stats()["workers"].items()}
+        if not ready:
+            raise SystemExit(
+                f"serve-fleet: workers never went ready: {states}; see "
+                f"logs under {launcher.log_dir}")
+        if "ready" not in states.values():
+            # wait_all_ready also returns when every worker SETTLED
+            # without serving (all quarantined: port collisions, a bad
+            # model dir) — an empty fleet front would answer only 503s
+            raise SystemExit(
+                f"serve-fleet: no worker became ready ({states}); see "
+                f"logs under {launcher.log_dir}")
+        # the front auto-registers the supervisor's fleet_process_*
+        # counters on its /metrics (router.supervisor installed above)
+        front = FleetServer(router, host=args.host,
+                            port=args.port).start()
+    except BaseException:  # noqa: BLE001 — cleanup-and-reraise: a failed boot must not LEAK spawned workers
+        supervisor.stop(grace_s=args.drain_grace_s)
+        router.stop()
+        raise
+    router.start_health_loop()
+    print(f"serve-fleet: {args.replicas} supervised worker processes in "
+          f"rotation; restart backoff {args.restart_backoff_s}s, "
+          f"crash-loop quarantine at {args.crash_loop_threshold} deaths "
+          f"in {args.crash_loop_window_s}s; supervision every "
+          f"{args.health_interval_s}s")
+    print(f"Serving fleet on {front.url} — POST /model/predict; "
+          f"GET /fleet/stats, /serving/stats, /metrics, /trace/recent, "
+          f"/healthz, /readyz")
+
+    term = threading.Event()
+    installed = prev = None
+    if threading.current_thread() is threading.main_thread():
+        prev = signal.signal(signal.SIGTERM, lambda *_: term.set())
+        installed = True
+    try:
+        if args.serve_seconds > 0:
+            term.wait(args.serve_seconds)
+        else:
+            while not term.wait(3600):
+                pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if term.is_set():
+            print(f"serve-fleet: SIGTERM — draining fleet (grace "
+                  f"{args.drain_grace_s}s)")
+            front.begin_drain()
+            stats_path = pathlib.Path(args.drain_stats)
+            try:
+                stats_path.write_text(json.dumps(
+                    router.fleet_stats(), indent=2))
+                where = str(stats_path)
+            except OSError as e:
+                where = f"LOST ({e})"
+            print(f"serve-fleet: stats snapshot -> {where}")
+        # clean SIGTERM per worker (each drains itself — cli serve's
+        # handler), escalation + reap on the grace expiring; the
+        # supervisor classifies every one of these deaths `clean`
+        supervisor.stop(grace_s=args.drain_grace_s)
         front.stop()
         if installed:
             signal.signal(signal.SIGTERM, prev)
@@ -1161,6 +1283,46 @@ def build_parser() -> argparse.ArgumentParser:
     p_fleet.add_argument("-health-interval-s", "--health-interval-s",
                          dest="health_interval_s", type=float, default=1.0,
                          help="router /readyz poll interval")
+    p_fleet.add_argument("-processes", "--processes",
+                         action="store_true",
+                         help="process-per-replica: spawn real `dl4j "
+                              "serve` worker processes (one per "
+                              "replica, worker-base-port + i) and "
+                              "supervise them end-to-end — crash "
+                              "detection, backoff restart, crash-loop "
+                              "quarantine (docs/robustness.md "
+                              "\"Process supervision\")")
+    p_fleet.add_argument("-worker-base-port", "--worker-base-port",
+                         dest="worker_base_port", type=int, default=8081,
+                         help="with -processes: worker i serves on "
+                              "base_port + i")
+    p_fleet.add_argument("-worker-log-dir", "--worker-log-dir",
+                         dest="worker_log_dir", default="fleet_logs",
+                         help="with -processes: per-worker rotating "
+                              "stdout/stderr capture directory")
+    p_fleet.add_argument("-restart-backoff-s", "--restart-backoff-s",
+                         dest="restart_backoff_s", type=float,
+                         default=0.5,
+                         help="with -processes: initial restart "
+                              "backoff (doubles per consecutive "
+                              "crash, jittered, capped)")
+    p_fleet.add_argument("-crash-loop-threshold",
+                         "--crash-loop-threshold",
+                         dest="crash_loop_threshold", type=int, default=3,
+                         help="with -processes: deaths inside the "
+                              "crash-loop window that quarantine a "
+                              "worker (surfaced in /fleet/stats)")
+    p_fleet.add_argument("-crash-loop-window-s", "--crash-loop-window-s",
+                         dest="crash_loop_window_s", type=float,
+                         default=60.0,
+                         help="with -processes: the crash-loop "
+                              "quarantine window")
+    p_fleet.add_argument("-ready-timeout-s", "--ready-timeout-s",
+                         dest="ready_timeout_s", type=float, default=120.0,
+                         help="with -processes: how long a spawned "
+                              "worker may take to go /readyz-green "
+                              "before it is killed and counted a crash "
+                              "(report carries its log tail)")
     p_fleet.add_argument("-autoscale", "--autoscale",
                          action="store_true",
                          help="queue-depth-driven scale up/down through "

@@ -28,9 +28,11 @@ from deeplearning4j_tpu.resilience.chaos import (
     ChaosDataSource,
     FleetChaosConfig,
     InjectedDispatchFault,
+    ProcessChaosConfig,
     ServingChaosConfig,
     chaos_dispatch,
     chaos_fleet,
+    chaos_procfleet,
     chaos_runner,
 )
 from deeplearning4j_tpu.resilience.faults import (
@@ -58,9 +60,11 @@ __all__ = [
     "ChaosDataSource",
     "FleetChaosConfig",
     "InjectedDispatchFault",
+    "ProcessChaosConfig",
     "ServingChaosConfig",
     "chaos_dispatch",
     "chaos_fleet",
+    "chaos_procfleet",
     "chaos_runner",
     "FaultReport",
     "PreemptedError",

@@ -37,6 +37,14 @@ dispatches are slowed at configured indices (drives load-skew /
 autoscale), and readyz probes lie at configured poll indices (drives
 eject -> half-open probe -> re-admit without killing anything) — again
 deterministic, counter-driven, CPU-only.
+
+Process SUPERVISION (ISSUE-10) gets real-process faults: `chaos_procfleet`
+SIGKILLs / SIGSTOPs actual worker processes at configured dispatch
+attempts and boot-flakes configured spawns (exit-code-N commands), so
+the `FleetSupervisor`'s crash detection, wedge escalation, backoff
+restart and crash-loop quarantine all run against genuine OS signals —
+deterministic and fast via the stdlib stub worker
+(`serving/_stub_worker.py`).
 """
 
 from __future__ import annotations
@@ -307,3 +315,128 @@ def chaos_fleet(router, config: FleetChaosConfig) -> _FleetChaos:
     are the test observables; call ``.uninstall()`` to restore the
     router's real hooks."""
     return _FleetChaos(router, config)
+
+
+# ---------------------------------------------------------------------------
+# Process-supervision fault injection (ISSUE-10)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessChaosConfig:
+    """Real-process faults for the `FleetSupervisor`
+    (serving/procfleet.py), keyed by deterministic counters.  Unlike
+    `FleetChaosConfig` (which stops a thread-hosted replica's server),
+    these act on actual worker PROCESSES with actual signals — the
+    supervisor must observe a genuine SIGKILL'd exit status and a
+    genuine SIGSTOP'd wedge.
+
+    - ``kill_at_dispatch``: just before router dispatch attempt #N
+      (0-based) the victim worker's process group gets SIGKILL — the
+      mid-storm hard kill.  Fires once.  Default victim is the worker
+      serving that attempt (the request in hand MUST fail over);
+      ``kill_worker`` names a specific victim.
+    - ``sigstop_at_dispatch``: same, with SIGSTOP — the process stays
+      ALIVE but stops answering, driving the wedged-but-alive
+      classification and the supervisor's hard-kill escalation.
+    - ``flake_boot_spawns``: supervisor-wide spawn indices (0-based, in
+      spawn order) whose command is replaced by one that exits
+      ``flake_exit_code`` immediately — the boot flake that drives
+      backoff restarts into crash-loop quarantine.
+    """
+
+    kill_at_dispatch: Optional[int] = None
+    kill_worker: Optional[str] = None
+    sigstop_at_dispatch: Optional[int] = None
+    sigstop_worker: Optional[str] = None
+    flake_boot_spawns: Sequence[int] = ()
+    flake_exit_code: int = 3
+
+
+class _ProcessChaos:
+    """Installed over a `FleetSupervisor`'s `_spawn_command` hook and
+    its router's `_dispatch` (instance attributes shadow the methods).
+    Counters: ``attempts`` (dispatch attempts), ``spawns`` (spawn
+    commands issued), ``killed``/``stopped`` (victim worker names)."""
+
+    def __init__(self, supervisor, config: ProcessChaosConfig):
+        import threading
+
+        self.supervisor = supervisor
+        self.config = config
+        self.attempts = 0
+        self.spawns = 0
+        self.killed: list = []
+        self.stopped: list = []
+        self._lock = threading.Lock()
+        self._orig_dispatch = supervisor.router._dispatch
+        self._orig_spawn_command = supervisor._spawn_command
+        supervisor.router._dispatch = self._dispatch
+        supervisor._spawn_command = self._spawn_command
+
+    def uninstall(self) -> None:
+        self.supervisor.router._dispatch = self._orig_dispatch
+        self.supervisor._spawn_command = self._orig_spawn_command
+
+    def _victim(self, replica, name: Optional[str]):
+        sup = self.supervisor
+        if name is not None:
+            return sup.workers.get(name)
+        for worker in sup.workers.values():
+            if worker.replica is replica:
+                return worker
+        return None
+
+    def _signal_worker(self, worker, sig) -> bool:
+        from deeplearning4j_tpu.runtime.launcher import kill_process_tree
+
+        proc = worker.proc if worker is not None else None
+        if proc is None or proc.poll() is not None:
+            return False
+        kill_process_tree(proc, sig)
+        return True
+
+    def _dispatch(self, replica, path, body, timeout=None,
+                  request_id=None):
+        import signal as _signal
+
+        cfg = self.config
+        with self._lock:
+            i = self.attempts
+            self.attempts += 1
+            kill = cfg.kill_at_dispatch == i and not self.killed
+            wedge = cfg.sigstop_at_dispatch == i and not self.stopped
+        if kill:
+            victim = self._victim(replica, cfg.kill_worker)
+            if self._signal_worker(victim, _signal.SIGKILL):
+                with self._lock:
+                    self.killed.append(victim.name)
+        if wedge:
+            victim = self._victim(replica, cfg.sigstop_worker)
+            if self._signal_worker(victim, _signal.SIGSTOP):
+                with self._lock:
+                    self.stopped.append(victim.name)
+        return self._orig_dispatch(replica, path, body, timeout,
+                                   request_id=request_id)
+
+    def _spawn_command(self, worker):
+        import sys
+
+        with self._lock:
+            i = self.spawns
+            self.spawns += 1
+        if i in self.config.flake_boot_spawns:
+            return [sys.executable, "-c",
+                    f"import sys; print('chaos: boot flake (spawn "
+                    f"{i})', flush=True); "
+                    f"sys.exit({int(self.config.flake_exit_code)})"]
+        return self._orig_spawn_command(worker)
+
+
+def chaos_procfleet(supervisor,
+                    config: ProcessChaosConfig) -> _ProcessChaos:
+    """Install deterministic process faults on a `FleetSupervisor` (see
+    `ProcessChaosConfig`): SIGKILL/SIGSTOP real worker processes at
+    configured dispatch attempts, boot-flake configured spawns.
+    Returns the installed wrapper; ``.uninstall()`` restores the real
+    hooks."""
+    return _ProcessChaos(supervisor, config)

@@ -21,10 +21,13 @@ from __future__ import annotations
 import json
 import os
 import pathlib
+import signal
+import socket
 import subprocess
 import sys
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 
 def initialize_multihost(coordinator_address: Optional[str] = None,
@@ -99,6 +102,136 @@ class ClusterConfigRegistry:
                       pathlib.Path(self.directory).glob("*.json"))
 
 
+class WorkerSpawnError(RuntimeError):
+    """Spawning a worker process failed for a reason the caller can act
+    on (port-bind collision after the retry, unlaunchable command).  The
+    message carries the worker's captured log tail when one exists."""
+
+
+def _port_in_use(host: str, port: int) -> bool:
+    """True when `host:port` is actively bound.  SO_REUSEADDR on the
+    probe socket matches the workers' own listen sockets, so a port in
+    TIME_WAIT (a restarted worker's previous incarnation) reads as FREE
+    — only a live listener collides."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind((host, int(port)))
+        except OSError:
+            return True
+    return False
+
+
+def rotate_log(path, max_bytes: int = 512 * 1024, keep: int = 3) -> None:
+    """Size-capped rotation: when `path` exceeds `max_bytes`, shift
+    ``path -> path.1 -> ... -> path.keep`` (oldest dropped).  Called at
+    spawn time, so one worker incarnation's log is never split
+    mid-stream — a crash report's tail always reads contiguously."""
+    path = pathlib.Path(path)
+    try:
+        if not path.exists() or path.stat().st_size <= max_bytes:
+            return
+        for i in range(keep - 1, 0, -1):
+            src = path.with_name(path.name + f".{i}")
+            if src.exists():
+                src.replace(path.with_name(path.name + f".{i + 1}"))
+        if keep >= 1:
+            path.replace(path.with_name(path.name + ".1"))
+    except OSError:
+        # rotation is best-effort: a full disk or permission hiccup must
+        # not block the spawn itself (the log just keeps growing)
+        pass
+
+
+def tail_lines(path, n: int = 20) -> str:
+    """The last `n` lines of a (possibly missing) log file — what gets
+    attached to ready-timeout and crash reports."""
+    try:
+        raw = pathlib.Path(path).read_bytes()
+    except OSError:
+        return "<no log captured>"
+    text = raw.decode("utf-8", errors="replace")
+    lines = text.splitlines()
+    return "\n".join(lines[-n:]) if lines else "<log empty>"
+
+
+def spawn_logged(command: List[str], log_path=None, *,
+                 host: Optional[str] = None, port: Optional[int] = None,
+                 bind_retry_delay_s: float = 0.5,
+                 max_log_bytes: int = 512 * 1024, log_keep: int = 3,
+                 on_bind_retry: Optional[Callable[[], None]] = None,
+                 env: Optional[Dict[str, str]] = None) -> subprocess.Popen:
+    """Spawn one worker process the supervisable way:
+
+    - stdout+stderr captured to `log_path` (size-rotated at spawn, with
+      a spawn-separator line) so crash/ready-timeout reports can attach
+      the last lines;
+    - its own session (process GROUP), so teardown can `killpg` the
+      worker *and* anything it forked instead of orphaning children;
+    - when `host`/`port` are given, a port-bind pre-check that retries
+      ONCE after `bind_retry_delay_s` (a restarting worker racing its
+      previous incarnation's close) before failing with a typed
+      `WorkerSpawnError` — never a silent spawn into a port another
+      process owns.
+    """
+    if host is not None and port is not None:
+        if _port_in_use(host, port):
+            if on_bind_retry is not None:
+                on_bind_retry()
+            time.sleep(max(0.0, float(bind_retry_delay_s)))
+            if _port_in_use(host, port):
+                tail = tail_lines(log_path) if log_path else ""
+                raise WorkerSpawnError(
+                    f"port {host}:{port} still bound after one "
+                    f"{bind_retry_delay_s}s bind-collision retry; refusing "
+                    f"to spawn {command[:3]}..."
+                    + (f"\nlast log lines:\n{tail}" if tail else ""))
+    stdout = stderr = None
+    log_f = None
+    if log_path is not None:
+        log_path = pathlib.Path(log_path)
+        log_path.parent.mkdir(parents=True, exist_ok=True)
+        rotate_log(log_path, max_bytes=max_log_bytes, keep=log_keep)
+        log_f = open(log_path, "ab")
+        log_f.write((f"--- spawn {time.strftime('%Y-%m-%dT%H:%M:%S')} "
+                     f"cmd={' '.join(map(str, command))}\n").encode())
+        log_f.flush()
+        stdout, stderr = log_f, subprocess.STDOUT
+    try:
+        proc = subprocess.Popen(command, stdout=stdout, stderr=stderr,
+                                start_new_session=True, env=env)
+    finally:
+        if log_f is not None:
+            # the child inherited the fd; the parent's copy would leak
+            # one open file per restart otherwise
+            log_f.close()
+    return proc
+
+
+def kill_process_tree(proc: subprocess.Popen,
+                      sig: int = signal.SIGKILL) -> None:
+    """Signal a spawned worker's whole process GROUP (it was started in
+    its own session — `spawn_logged`), falling back to the process alone
+    when the group is not ours to signal.  Killing only the leader would
+    orphan anything the worker forked."""
+    try:
+        pgid = os.getpgid(proc.pid)
+    except (ProcessLookupError, OSError):
+        pgid = None
+    if pgid is not None and pgid == proc.pid:
+        # only when the worker IS its group's leader (start_new_session):
+        # signalling some inherited group could hit the parent itself
+        try:
+            os.killpg(pgid, sig)
+            return
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+    try:
+        proc.send_signal(sig)
+    except (ProcessLookupError, OSError):
+        pass
+
+
 def replica_serve_command(model_dir: str, *, host: str = "127.0.0.1",
                           port: int = 8081, buckets: str = "1,8,32",
                           max_batch: int = 32, max_wait_ms: float = 2.0,
@@ -141,10 +274,16 @@ class FleetProcessLauncher:
     (serving/fleet.py): replica i is its own `dl4j serve` process on
     `base_port + i` — a replica crash is a real process death, and the
     router's failover/ejection path sees exactly what it would see in
-    production.  Tier-1 tests cover command generation and URL layout;
-    `spawn()` Popens the workers (each takes seconds to warm up, so the
-    CPU test tier hosts replicas in threads instead —
-    `serving.fleet.spawn_local_replica`)."""
+    production.  `spawn()` launches workers in their own sessions with
+    rotating per-worker log capture and a port-bind-collision retry;
+    `stop()`/`kill()`/`stop_all()` always reap.  End-to-end process
+    supervision (crash detection, backoff restart, crash-loop
+    quarantine, re-attach) lives in `serving.procfleet.FleetSupervisor`
+    — `FleetSupervisor.manage_launcher(launcher)` hands it these
+    workers.  The CPU test tier hosts replicas in threads
+    (`serving.fleet.spawn_local_replica`) where process boot cost would
+    dominate; process-path acceptance runs against the stdlib stub
+    worker (`serving/_stub_worker.py`)."""
 
     model_dir: str
     n_replicas: int = 2
@@ -158,6 +297,15 @@ class FleetProcessLauncher:
     deadline_ms: Optional[float] = None
     breaker_threshold: Optional[int] = None
     quantize: Optional[str] = None
+    # per-worker stdout/stderr capture (None = inherit the launcher's):
+    # {log_dir}/worker-{i}.log, size-rotated at spawn
+    log_dir: Optional[str] = None
+    max_log_bytes: int = 512 * 1024
+    log_rotations: int = 3
+    # spawned children, by worker index — `spawn`/`stop`/`kill` keep this
+    # reaped (`wait()`ed) so spawn/kill cycles never accumulate zombies
+    procs: Dict[int, subprocess.Popen] = field(default_factory=dict,
+                                               repr=False)
 
     def port(self, i: int) -> int:
         return int(self.base_port) + int(i)
@@ -177,11 +325,73 @@ class FleetProcessLauncher:
             breaker_threshold=self.breaker_threshold,
             quantize=self.quantize)
 
-    def spawn(self, i: int) -> "subprocess.Popen":
-        return subprocess.Popen(self.command(i))
+    def log_path(self, i: int) -> Optional[pathlib.Path]:
+        if self.log_dir is None:
+            return None
+        return pathlib.Path(self.log_dir) / f"worker-{i}.log"
+
+    def tail_log(self, i: int, lines: int = 20) -> str:
+        """The worker's last captured log lines (attached to crash and
+        ready-timeout reports); a placeholder string when no `log_dir`
+        was configured."""
+        path = self.log_path(i)
+        return tail_lines(path, lines) if path else "<no log captured>"
+
+    def spawn(self, i: int,
+              on_bind_retry: Optional[Callable[[], None]] = None
+              ) -> "subprocess.Popen":
+        """Spawn worker `i` in its own session with log capture and the
+        one-shot port-bind-collision retry (`spawn_logged`).  A previous
+        incarnation that already exited is `wait()`ed first — repeated
+        spawn/kill cycles must never accumulate defunct children."""
+        prev = self.procs.get(i)
+        if prev is not None and prev.poll() is not None:
+            prev.wait()
+        proc = spawn_logged(self.command(i), self.log_path(i),
+                            host=self.host, port=self.port(i),
+                            max_log_bytes=self.max_log_bytes,
+                            log_keep=self.log_rotations,
+                            on_bind_retry=on_bind_retry)
+        self.procs[i] = proc
+        return proc
 
     def spawn_all(self) -> List["subprocess.Popen"]:
         return [self.spawn(i) for i in range(int(self.n_replicas))]
+
+    def stop(self, i: int, grace_s: float = 5.0) -> bool:
+        """SIGTERM worker `i` (graceful drain — cli.py installs the
+        handler), escalate to a process-group SIGKILL after `grace_s`,
+        and ALWAYS `wait()` the child so it is reaped.  Returns True
+        when the worker exited within the grace window."""
+        proc = self.procs.get(i)
+        if proc is None:
+            return True
+        drained = True
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=max(0.0, float(grace_s)))
+            except subprocess.TimeoutExpired:
+                drained = False
+                kill_process_tree(proc)
+        proc.wait()
+        return drained
+
+    def kill(self, i: int) -> None:
+        """SIGKILL worker `i`'s whole process group and reap it — the
+        chaos 'worker process died' fault, and the teardown path for a
+        wedged (SIGSTOP'd) worker that cannot answer a SIGTERM."""
+        proc = self.procs.get(i)
+        if proc is None:
+            return
+        kill_process_tree(proc)
+        proc.wait()
+
+    def stop_all(self, grace_s: float = 5.0) -> bool:
+        drained = True
+        for i in list(self.procs):
+            drained &= self.stop(i, grace_s=grace_s)
+        return drained
 
     def wait_ready(self, i: int, timeout_s: float = 60.0,
                    poll_interval_s: float = 0.5) -> bool:
@@ -223,10 +433,14 @@ class FleetProcessLauncher:
         out = []
         for i, proc in enumerate(procs):
             if not self.wait_ready(i, timeout_s=ready_timeout_s):
+                # the timeout report must say WHY the worker never went
+                # green — its own captured output, not a bare timeout
                 raise TimeoutError(
                     f"worker-{i} at {self.url(i)} not ready after "
                     f"{ready_timeout_s}s; {len(procs)} spawned worker "
-                    f"processes left running for the caller to reap")
+                    f"processes left running for the caller to reap "
+                    f"(launcher.stop_all()).\nworker-{i} last log "
+                    f"lines:\n{self.tail_log(i)}")
             # "worker-{i}", not "replica-{i}": the router's own factory
             # names replicas "replica-{seq}", and failover exclusion /
             # pick tie-breaks key on the NAME — a collision would make

@@ -43,7 +43,16 @@ coalescing K concurrent *requests* per device dispatch.
   weight swaps, queue-depth autoscale through graceful drain — plus the
   `FleetServer` HTTP front (`/fleet/stats`) and `spawn_local_replica`
   for thread-hosted replicas (process-per-replica launching lives in
-  `runtime.launcher.FleetProcessLauncher`).
+  `runtime.launcher.FleetProcessLauncher`);
+- process supervision (`procfleet.py`, ISSUE-10): `FleetSupervisor`
+  owns spawned worker processes end-to-end — exit-status + `/readyz`
+  crash detection with clean/crash/wedged classification, exponential
+  jittered backoff restarts re-admitted through warm-then-attach,
+  crash-loop quarantine behind a typed `CrashLoopError`, cross-host
+  attach by URL with restart delegated to a pluggable `RestartPolicy`,
+  rotating per-worker log capture with tails on crash reports, and
+  `fleet_process_*` obs counters (docs/robustness.md "Process
+  supervision").
 
 See docs/performance.md (serving cost model), docs/architecture.md and
 docs/robustness.md ("serving plane", "serving fleet").
@@ -71,6 +80,12 @@ from deeplearning4j_tpu.serving.paged import (
     PagePool,
     RadixPrefixCache,
 )
+from deeplearning4j_tpu.serving.procfleet import (
+    CrashLoopError,
+    FleetSupervisor,
+    RestartPolicy,
+    WorkerSpec,
+)
 from deeplearning4j_tpu.serving.resilience import (
     CircuitBreaker,
     CircuitOpenError,
@@ -86,12 +101,15 @@ __all__ = [
     "CircuitBreaker",
     "CircuitOpenError",
     "ContinuousLMServer",
+    "CrashLoopError",
     "DEFAULT_BATCH_BUCKETS",
     "DeadlineExceededError",
     "FleetClientError",
     "FleetRouter",
     "FleetServer",
+    "FleetSupervisor",
     "MicroBatcher",
+    "RestartPolicy",
     "PageLeakError",
     "PagePool",
     "RadixPrefixCache",
@@ -102,6 +120,7 @@ __all__ = [
     "ServingOverloadError",
     "ServingUnavailableError",
     "UnservableShapeError",
+    "WorkerSpec",
     "check_fleet_ledger",
     "pow2_length_buckets",
     "spawn_local_replica",

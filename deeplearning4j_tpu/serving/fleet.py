@@ -335,6 +335,10 @@ class FleetRouter:
         self.scale_downs = 0
         self.health_polls = 0
         self.autoscale = False   # health loop calls autoscale_tick() too
+        # process supervision (ISSUE-10): a FleetSupervisor installs
+        # itself here so /fleet/stats carries the supervision section
+        # (worker states, death classifications, quarantines)
+        self.supervisor = None
         self._autoscale_busy = threading.Lock()
         # ledger counts of gracefully retired replicas (rolling swap /
         # scale-down) + how many retired without reporting (process
@@ -916,6 +920,9 @@ class FleetRouter:
                 prefix["hits"] / prefix["queries"], 3)
             fleet["lm_prefix"] = prefix
         out = {"fleet": fleet, "replicas": entries, "retired": retired}
+        supervisor = self.supervisor
+        if supervisor is not None:
+            out["supervision"] = supervisor.stats()
         if include_replica_stats:
             out["ledger"] = check_fleet_ledger(out)
         return out
@@ -1141,6 +1148,12 @@ class FleetServer:
         self.registry.register_collector(self._fleet_samples)
         self.registry.register_collector(
             compile_watcher().collector_samples)
+        if router.supervisor is not None:
+            # process supervision installed before the front: its
+            # fleet_process_* counters ride this /metrics (a supervisor
+            # attached later registers itself via register_collector)
+            self.registry.register_collector(
+                router.supervisor.collector_samples)
         self.registry.gauge(
             "server_uptime_seconds", "seconds since server construction",
             fn=lambda: self.registry.uptime_s)

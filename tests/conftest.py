@@ -51,6 +51,12 @@ def pytest_configure(config):
         "markers", "obs: observability-plane tests — metrics registry "
         "+ Prometheus exposition, request tracing across the fleet, "
         "compile watcher, training telemetry (fast; run in tier-1)")
+    config.addinivalue_line(
+        "markers", "procfleet: process-supervision tests — crash "
+        "detection/classification, backoff restart, crash-loop "
+        "quarantine, cross-host attach, launcher spawn/reap/log "
+        "hygiene (real processes via the stdlib stub worker; fast, "
+        "run in tier-1 — full `dl4j serve` worker spawns are `slow`)")
 
 
 @pytest.fixture

@@ -43,8 +43,19 @@ SERVING_ALLOWLIST: dict = {
                                                  # front must keep
                                                  # serving (500 once,
                                                  # typed stay 4xx/503)
+    "deeplearning4j_tpu/serving/procfleet.py": 1,  # supervision-loop
+                                                   # survival backstop:
+                                                   # a bug in one sweep
+                                                   # must not end ALL
+                                                   # future restarts
 }
 SERVING_PREFIX = "deeplearning4j_tpu/serving/"
+
+# The process launcher gets the strict bar too (ISSUE-10): a swallowed
+# exception around spawn/reap/kill is how zombies and orphaned worker
+# process groups hide — no broad handlers at all, pragma'd or not.
+LAUNCHER_ALLOWLIST: dict = {}
+LAUNCHER_PREFIX = "deeplearning4j_tpu/runtime/launcher.py"
 
 # The observability plane gets the same strict bar (ISSUE-8): a
 # swallowed exception inside a metrics/trace hook silently blinds the
@@ -57,6 +68,7 @@ OBS_PREFIX = "deeplearning4j_tpu/obs/"
 STRICT_PREFIXES = (
     (SERVING_PREFIX, SERVING_ALLOWLIST, "SERVING_ALLOWLIST"),
     (OBS_PREFIX, OBS_ALLOWLIST, "OBS_ALLOWLIST"),
+    (LAUNCHER_PREFIX, LAUNCHER_ALLOWLIST, "LAUNCHER_ALLOWLIST"),
 )
 
 PACKAGE = "deeplearning4j_tpu"

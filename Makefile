@@ -4,9 +4,12 @@
 .PHONY: test test-serving test-precision test-fleet test-paged \
 	test-procfleet dryrun bench smoke serving-smoke bench-precision \
 	bench-fleet bench-paged bench-procfleet test-obs bench-obs \
-	obs-smoke evidence lint
+	obs-smoke evidence lint test-lint
 
-test:
+# lint first: the four-pass static sweep is ~1s and fails fast on a
+# race/host-sync/recompile-hazard/broad-except finding before the
+# (much slower) runtime suite spins up.
+test: lint
 	python -m pytest tests/ -x -q
 
 # Serving subsystem only (micro-batcher, bucket ladder, continuous LM).
@@ -60,10 +63,17 @@ bench-obs:
 # The obs CI gate: tests + the overhead row.
 obs-smoke: test-obs bench-obs
 
-# Broad-except linter (see docs/robustness.md): fails on new bare
-# `except Exception:` in deeplearning4j_tpu/ without a noqa pragma.
+# First-party static analysis (docs/static-analysis.md): lock-discipline
+# race detector (LCK), jit-purity/host-sync (JIT), recompile hazards
+# (RCP), broad excepts (BLE).  Fails on any finding not frozen in
+# tools/dl4jlint/lint_baseline.json; < 10s budget asserted in tier-1.
 lint:
-	python tools/lint_excepts.py
+	python -m tools.dl4jlint
+
+# Lint-framework tests only (per-pass fixtures, baseline workflow, the
+# zero-new-findings sweep + <10s budget gate).
+test-lint:
+	python -m pytest tests/ -q -m lint
 
 # Multichip dryrun (8 virtual CPU devices) + committed evidence log in
 # EVIDENCE/. Safe under a wedged TPU tunnel (env decision precedes jax).

@@ -377,11 +377,14 @@ def _lm_mesh_train(args, cfg, ids, B, S):
         starts = rng.integers(0, len(ids) - S - 1, B)
         tokens = np.stack([ids[s:s + S] for s in starts])
         targets = np.stack([ids[s + 1:s + S + 1] for s in starts])
-        loss = trainer.fit_batch(tokens, targets)
+        # async step (JIT107): the loss stays on device so step k+1's
+        # dispatch overlaps step k; only a due report forces the sync
+        loss = trainer.fit_batch_async(tokens, targets)
         if args.verbose and (k + 1) % 20 == 0:
-            print(f"step {k + 1}/{steps} loss {loss:.4f}")
-    tok_rate = steps * B * S / max(time.time() - t0, 1e-9)
-    print(f"Trained {steps} steps (final loss {loss:.4f}, "
+            print(f"step {k + 1}/{steps} loss {float(loss):.4f}")
+    final_loss = float(loss)   # sync BEFORE reading the clock, or the
+    tok_rate = steps * B * S / max(time.time() - t0, 1e-9)  # rate lies
+    print(f"Trained {steps} steps (final loss {final_loss:.4f}, "
           f"{tok_rate:.0f} tokens/sec)")
     return trainer.export_params()
 

@@ -1025,7 +1025,9 @@ class MultiLayerNetwork:
             self.init()
         if self._jit_forward is None:
             out_dtype = jnp.dtype(self._precision.output_dtype)
-            self._jit_forward = jax.jit(
+            # the closure captures only static config through self
+            # (conf/impls); set_precision clears this cache on mutation
+            self._jit_forward = jax.jit(  # noqa: RCP202 — built once, invalidated by set_precision
                 lambda p, s, x, mask: self._forward(
                     p, s, x, train=False, mask=mask)[0].astype(out_dtype))
         return self._jit_forward(self.params, self.state, jnp.asarray(x), mask)
@@ -1082,7 +1084,7 @@ class MultiLayerNetwork:
         if self.params is None:
             self.init()
         if self._jit_score is None:
-            self._jit_score = jax.jit(
+            self._jit_score = jax.jit(  # noqa: RCP202 — built once, invalidated by set_precision
                 lambda p, s, x, y, mask: self._objective(
                     p, s, x, y, rng=None, mask=mask)[0])
         return float(self._jit_score(

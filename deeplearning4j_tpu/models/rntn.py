@@ -143,10 +143,15 @@ class RNTN:
         arrays = tuple(jnp.asarray(a) for a in (
             prog.is_leaf, prog.word, prog.left, prog.right, prog.label,
             prog.mask * prog.labeled))
-        self.losses = []
+        # enqueue every epoch's step without a host sync (JIT107), then
+        # pull the whole loss curve back in one deferred sweep — the
+        # per-epoch float() blocked the host mid-curriculum for nothing
+        self.losses = []     # a failed fit must not keep a stale curve
+        device_losses = []
         for _ in range(self.epochs):
             self.params, ada, loss = self._step(self.params, ada, *arrays)
-            self.losses.append(float(loss))
+            device_losses.append(loss)
+        self.losses = [float(l) for l in device_losses]
         return self
 
     # -- inference ----------------------------------------------------------

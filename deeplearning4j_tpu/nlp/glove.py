@@ -182,7 +182,12 @@ class Glove(WordVectors):
         params, adagrad = self._params, self._adagrad
         for _ in range(epochs):
             rng.shuffle(order)
-            total = 0.0
+            # keep every minibatch loss ON DEVICE (JIT107): a float()
+            # per minibatch blocks the host every step, so back-to-back
+            # batches could never pipeline; the syncs all land at the
+            # epoch boundary, summed on host in float64 so the reported
+            # curve matches the pre-pipelining numbers
+            epoch_losses = []
             for s in range(0, len(order), B):
                 sel = order[s:s + B]
                 valid = np.ones(B, np.float32)
@@ -194,8 +199,8 @@ class Glove(WordVectors):
                     params, adagrad, jnp.asarray(ii[sel]),
                     jnp.asarray(jj[sel]), jnp.asarray(xx[sel]),
                     jnp.asarray(valid))
-                total += float(loss)
-            losses.append(total)
+                epoch_losses.append(loss)
+            losses.append(sum(float(l) for l in epoch_losses))
         self._params, self._adagrad = params, adagrad
         self._refresh_syn0()
         return losses

@@ -120,7 +120,11 @@ class CompileWatcher:
         """O(1) hot-path guard: did ANY compile end at/after `t`?  The
         tracer checks this before paying for `events_between` — on a
         warmed serving path it is False for every request."""
-        events = self._events
+        # deliberately lock-free (this runs per REQUEST on the trace
+        # path): deque ops are GIL-atomic, and the one observable race
+        # — reading [-1] while a bounded rotation empties it — is
+        # caught below and answered conservatively
+        events = self._events  # noqa: LCK101 — lock-free hot-path guard, race handled
         if not events:
             return False
         try:

@@ -180,13 +180,20 @@ class HybridParallelTrainer:
 
         self._step = jax.jit(step, donate_argnums=(0, 1))
 
-    def fit_batch(self, tokens, targets) -> float:
+    def fit_batch_async(self, tokens, targets):
+        """One SPMD step; returns the loss as a DEVICE array without
+        synchronizing (JIT107 discipline: back-to-back steps pipeline
+        on the chips — sync only where a report is due)."""
         dsh = NamedSharding(self.mesh, P(self.axes.data, self.axes.seq))
         tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), dsh)
         targets = jax.device_put(jnp.asarray(targets, jnp.int32), dsh)
         self.params, self.opt_state, loss = self._step(
             self.params, self.opt_state, tokens, targets)
-        return float(loss)
+        return loss
+
+    def fit_batch(self, tokens, targets) -> float:
+        """`fit_batch_async` + host sync on the loss."""
+        return float(self.fit_batch_async(tokens, targets))
 
     def export_params(self) -> dict:
         """Gathered host copy of the params in the standard
@@ -350,7 +357,10 @@ class PipelineParallelTrainer:
             check_rep=False)
         return jax.jit(fn, donate_argnums=(0, 1, 2, 3))
 
-    def fit_batch(self, tokens, targets) -> float:
+    def fit_batch_async(self, tokens, targets):
+        """One pipelined step; returns the loss as a DEVICE array
+        without synchronizing (JIT107 discipline: the microbatch
+        schedule of step k+1 overlaps step k's tail)."""
         tokens = jnp.asarray(tokens, jnp.int32)
         n_data = dict(zip(self.mesh.axis_names,
                           self.mesh.devices.shape))[self.axes[0]]
@@ -365,7 +375,11 @@ class PipelineParallelTrainer:
         (self.stage_params, self.io_params, self.stage_opt, self.io_opt,
          loss) = self._step(self.stage_params, self.io_params,
                             self.stage_opt, self.io_opt, tokens, targets)
-        return float(loss)
+        return loss
+
+    def fit_batch(self, tokens, targets) -> float:
+        """`fit_batch_async` + host sync on the loss."""
+        return float(self.fit_batch_async(tokens, targets))
 
     def export_params(self) -> dict:
         """Gathered host copy in the standard `transformer.init_params`

@@ -184,7 +184,9 @@ class QuantizedNet:
         import jax.numpy as jnp
 
         if self._jit_forward is None:
-            self._jit_forward = jax.jit(
+            # captures static layer config through self; the quantized
+            # view is immutable after construction (ISSUE-5 contract)
+            self._jit_forward = jax.jit(  # noqa: RCP202 — immutable view, built once
                 lambda qp, s, x, mask: self._forward(qp, s, x, mask))
         return self._jit_forward(self.qparams, self.net.state,
                                  jnp.asarray(x), mask)

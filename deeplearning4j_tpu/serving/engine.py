@@ -121,13 +121,16 @@ class ServingEngine:
         cannot quantize twice), else the float net."""
         if self.quantize is None:
             return self.net
-        if self._qnet is None:
+        # double-checked fast path: after the first request this is one
+        # unlocked read per dispatch; the slow path re-checks under the
+        # lock, and the build happens exactly once
+        if self._qnet is None:  # noqa: LCK101 — DCL fast path, locked recheck below
             from deeplearning4j_tpu.precision import QuantizedNet
 
             with self._qlock:
                 if self._qnet is None:
                     self._qnet = QuantizedNet(self.net, dtype=self.quantize)
-        return self._qnet
+        return self._qnet  # noqa: LCK101 — set-once under _qlock, never cleared
 
     def _guard_shape(self, shape, dtype: str) -> None:
         """Compile-count guard: a dispatch shape beyond the ladder bound
@@ -255,8 +258,14 @@ class ServingEngine:
             prefix="classifier:")
         out["accepting"] = self.accepting
         out["quantize"] = self.quantize
-        if self._qnet is not None:
-            out["quantization"] = self._qnet.quantization_report()
+        # snapshot the reference WITHOUT _qlock: _model() holds that
+        # lock for the entire first QuantizedNet build (compile-scale),
+        # and a stats scrape must not stall behind it.  The unlocked
+        # read is safe — the reference is assigned exactly once, under
+        # the lock, after the view is fully built (GIL-atomic publish)
+        qnet = self._qnet  # noqa: LCK101 — set-once publish; locking would stall scrapes on the first build
+        if qnet is not None:
+            out["quantization"] = qnet.quantization_report()
         return out
 
     @property

@@ -553,7 +553,7 @@ class ContinuousLMServer:
         mid-step leaves `self._cache` pointing at deleted buffers —
         without a rebuild the keep-serving path would fail every later
         request.  Host-side page state is reset separately
-        (`_reset_pool`) because it must happen BEFORE the next admit
+        (`_reset_pool_locked`) because it must happen BEFORE the next admit
         round, while the device rebuild may be deferred to dispatch."""
         if self.kv == "dense":
             from deeplearning4j_tpu.parallel.generation import (
@@ -570,11 +570,13 @@ class ContinuousLMServer:
                                      self.page_size)
         self._cache = (cache["k"], cache["v"])
 
-    def _reset_pool(self) -> None:
+    def _reset_pool_locked(self) -> None:
         """Fresh allocator + radix tree + slot page bookkeeping.  Called
         at start and whenever the device pool's CONTENTS died (failed
         dispatch, worker stop): a radix entry pointing into a rebuilt
-        pool would serve zeros as a cached prefix."""
+        pool would serve zeros as a cached prefix.  Caller holds
+        ``self._cond`` (the ``*_locked`` contract — admission reads the
+        pool/tree/CoW list under the same lock)."""
         if self.kv != "paged":
             return
         self._pool = PagePool(self.kv_pages + 1, self.page_size)
@@ -621,7 +623,7 @@ class ContinuousLMServer:
                               temperature, seeds, counts)
 
                 self._step = dispatch
-            self._reset_pool()
+            self._reset_pool_locked()
             self._reset_cache()
         self._running = True
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -678,7 +680,12 @@ class ContinuousLMServer:
         return {"full": full, "partial": partial, "fresh": fresh,
                 "matched": matched, "total_pages": total_pages}
 
-    def _install_paged(self, slot: _Slot, req: _LMRequest, plan) -> None:
+    def _install_paged_locked(self, slot: _Slot, req: _LMRequest,
+                              plan) -> None:
+        """Bind one admitted request to a lane.  Caller holds
+        ``self._cond`` (the ``*_locked`` contract): the pending-CoW
+        append below races the worker's swap in `_drain_step`
+        otherwise."""
         slot.req = req
         req.t_installed = time.perf_counter()
         req.prefix_matched = plan["matched"]
@@ -745,7 +752,7 @@ class ContinuousLMServer:
                 if plan is None:
                     break              # head-of-line waits for pages
                 req = self._queue.popleft()
-                self._install_paged(slot, req, plan)
+                self._install_paged_locked(slot, req, plan)
             else:
                 slot.req = self._queue.popleft()
                 slot.req.t_installed = time.perf_counter()
@@ -984,7 +991,7 @@ class ContinuousLMServer:
                     self._queue.clear()
                     # page contents survive a stop only as long as the
                     # buffers do — release everything in one sweep
-                    self._reset_pool()
+                    self._reset_pool_locked()
                     if self._warm_req is not None:
                         # a warmup() waiting on a stopped server must
                         # unblock, not sit out its timeout
@@ -1015,7 +1022,7 @@ class ContinuousLMServer:
                     # the next round rebuilds it inside this same
                     # protected loop (a rebuild that throws then fails
                     # THAT round's requests, not the worker)
-                    self._reset_pool()
+                    self._reset_pool_locked()
                     self._cache = None
                 busy = True
             if not busy:

@@ -147,7 +147,7 @@ class ServingMetrics:
         # attribute read per record call (the slow path's lock still
         # makes the one assignment race-free) — per-record lock traffic
         # is exactly what the bench obs row's 3% budget polices
-        if self._started is not None:
+        if self._started is not None:  # noqa: LCK101 — DCL fast path; write is locked below
             return
         with self._lock:
             if self._started is None:

@@ -310,7 +310,7 @@ class RadixPrefixCache:
     def clear(self) -> int:
         """Release every tree-held page back to THIS pool.  Diagnostic
         /test helper only: the server's real reset path
-        (`ContinuousLMServer._reset_pool`) discards the pool and tree
+        (`ContinuousLMServer._reset_pool_locked`) discards the pool and tree
         wholesale instead, because after a failed dispatch the device
         page CONTENTS are gone too and per-slot bookkeeping must reset
         with them — clear() alone would leave that state stale."""

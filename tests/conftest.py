@@ -57,6 +57,10 @@ def pytest_configure(config):
         "quarantine, cross-host attach, launcher spawn/reap/log "
         "hygiene (real processes via the stdlib stub worker; fast, "
         "run in tier-1 — full `dl4j serve` worker spawns are `slow`)")
+    config.addinivalue_line(
+        "markers", "lint: dl4jlint static-analysis gates — per-pass "
+        "fixtures, baseline workflow, the zero-new-findings sweep over "
+        "the real tree (pure AST, no jax; fast, run in tier-1)")
 
 
 @pytest.fixture

@@ -1,0 +1,663 @@
+"""Tier-1 wiring for tools/dl4jlint (ISSUE-11): the four static-analysis
+passes each prove a positive (known-bad flagged) and a negative
+(known-good clean) fixture, the baseline workflow round-trips, and the
+REAL tree reports zero non-baselined findings inside a wall-clock budget
+that keeps the gate cheap enough for tier-1."""
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.dl4jlint import engine  # noqa: E402
+from tools.dl4jlint.pass_excepts import BroadExceptPass  # noqa: E402
+from tools.dl4jlint.pass_jit import JitPurityPass  # noqa: E402
+from tools.dl4jlint.pass_locks import LockDisciplinePass  # noqa: E402
+from tools.dl4jlint.pass_recompile import RecompileHazardPass  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+ALL_PASSES = [LockDisciplinePass(), JitPurityPass(),
+              RecompileHazardPass(), BroadExceptPass()]
+
+
+def _tree(tmp_path, files):
+    """Write a fake repo: {relpath: source} under tmp_path, with package
+    __init__ stubs, and return the root."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        for parent in p.relative_to(tmp_path).parents:
+            init = tmp_path / parent / "__init__.py"
+            if str(parent) != "." and not init.exists():
+                init.write_text("")
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def _run(root, select=None):
+    return engine.run_passes(root, passes=ALL_PASSES, select=select)
+
+
+def _codes(findings):
+    return sorted({f.code for f in findings})
+
+
+# ---- pass_locks: lock-discipline race detector ---------------------------
+
+LOCKY_BAD = """
+    import threading
+
+    class Ledger:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+
+        def add(self, n):
+            with self._lock:
+                self._count += n
+
+        def peek(self):
+            return self._count          # unlocked read of guarded state
+"""
+
+LOCKY_GOOD = """
+    import threading
+
+    class Ledger:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self.name = "ok"            # read-only config, never locked
+
+        def add(self, n):
+            with self._lock:
+                self._count += n
+
+        def peek(self):
+            with self._lock:
+                return self._count
+
+        def _bump_locked(self):
+            self._count += 1            # *_locked convention: exempt
+
+        def label(self):
+            return self.name
+"""
+
+
+def test_locks_flags_unlocked_access_to_guarded_attr(tmp_path):
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/serving/ledger.py": LOCKY_BAD})
+    found = _run(root, select=["locks"])
+    assert [f.code for f in found] == ["LCK101"]
+    assert found[0].symbol == "_count"
+    assert found[0].scope == "Ledger.peek"
+
+
+def test_locks_accepts_disciplined_class(tmp_path):
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/serving/ledger.py": LOCKY_GOOD})
+    assert _run(root, select=["locks"]) == []
+
+
+def test_locks_scope_is_limited_to_threaded_planes(tmp_path):
+    # the same racy class under nn/ (single-threaded math) is not the
+    # lock pass's business
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/nn/ledger.py": LOCKY_BAD})
+    assert _run(root, select=["locks"]) == []
+
+
+def test_locks_pragma_suppresses(tmp_path):
+    src = LOCKY_BAD.replace(
+        "return self._count          # unlocked read of guarded state",
+        "return self._count  # noqa: LCK101 — torn read acceptable here")
+    root = _tree(tmp_path, {"deeplearning4j_tpu/serving/ledger.py": src})
+    assert _run(root, select=["locks"]) == []
+
+
+def test_locks_flags_wrong_lock_access(tmp_path):
+    # a field guarded by _b read under _a is as torn as one read under
+    # no lock at all — the multi-lock classes (ServingEngine,
+    # FleetRouter) make this shape real
+    root = _tree(tmp_path, {"deeplearning4j_tpu/serving/two.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._x = 0
+
+            def w(self):
+                with self._b:
+                    self._x = 1
+
+            def r(self):
+                with self._a:
+                    return self._x
+    """})
+    found = _run(root, select=["locks"])
+    assert [f.scope for f in found] == ["C.r"]
+    assert "self._b" in found[0].message
+
+
+def test_locks_models_container_mutations_as_writes(tmp_path):
+    # `self._queue.append(...)` / `self._table[k] = v` are writes even
+    # though ast sees ctx=Load on the attribute — the serving plane's
+    # shared state is mostly deques/dicts, not rebinds
+    root = _tree(tmp_path, {"deeplearning4j_tpu/serving/q.py": """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = []
+                self._table = {}
+
+            def put(self, x):
+                with self._lock:
+                    self._queue.append(x)
+                    self._table[x] = x
+
+            def take(self):
+                return self._queue.pop()     # unlocked mutator
+
+            def drop(self, k):
+                del self._table[k]           # unlocked subscript-del
+    """})
+    found = _run(root, select=["locks"])
+    assert sorted((f.scope, f.symbol) for f in found) == [
+        ("Q.drop", "_table"), ("Q.take", "_queue")]
+
+
+# ---- pass_jit: host syncs inside traced functions ------------------------
+
+JITTY_BAD = """
+    import jax
+    import time
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        print("tracing", x)             # JIT104
+        t = time.perf_counter()         # JIT105
+        v = float(x.sum())              # JIT101
+        return np.asarray(x) + v + t    # JIT103
+
+    def body(carry, x):
+        return carry + x.item(), None   # JIT102 (scan body below)
+
+    def scan_all(xs):
+        return jax.lax.scan(body, 0.0, xs)
+"""
+
+JITTY_GOOD = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        n = int(x.shape[0])             # static: not a host sync
+        return jnp.sum(x) / n
+
+    def host_report(x):
+        return float(x.sum())           # not traced: host side is free
+"""
+
+
+def test_jit_flags_host_syncs_in_traced_functions(tmp_path):
+    root = _tree(tmp_path, {"deeplearning4j_tpu/nn/steps.py": JITTY_BAD})
+    found = _run(root, select=["jit"])
+    assert _codes(found) == ["JIT101", "JIT102", "JIT103", "JIT104",
+                             "JIT105"]
+
+
+def test_jit_accepts_pure_traced_function(tmp_path):
+    root = _tree(tmp_path, {"deeplearning4j_tpu/nn/steps.py": JITTY_GOOD})
+    assert _run(root, select=["jit"]) == []
+
+
+def test_jit_flags_unconditional_step_result_sync(tmp_path):
+    # JIT107: the driver-side per-step float() that serializes dispatch
+    root = _tree(tmp_path, {"deeplearning4j_tpu/parallel/tr.py": """
+        class T:
+            def fit_batch(self, x):
+                self.params, loss = self._step(self.params, x)
+                return float(loss)
+    """})
+    found = _run(root, select=["jit"])
+    assert [f.code for f in found] == ["JIT107"]
+
+
+def test_jit_try_finally_does_not_exempt_step_sync(tmp_path):
+    # a try body / finally runs every iteration — the retry-wrapped
+    # per-step sync is still unconditional; only real branches (If /
+    # except handlers / else) are
+    root = _tree(tmp_path, {"deeplearning4j_tpu/parallel/tr.py": """
+        class T:
+            def fit_batch(self, x):
+                self.params, loss = self._step(self.params, x)
+                try:
+                    return float(loss)
+                finally:
+                    self.cleanup()
+
+            def fit_guarded(self, x):
+                self.params, loss = self._step(self.params, x)
+                try:
+                    self.dispatch()
+                except RuntimeError:
+                    self.report(float(loss))   # error path: conditional
+                return loss
+
+            def fit_tested(self, x):
+                self.params, loss = self._step(self.params, x)
+                if float(loss) > 3.0:          # If.test runs EVERY step
+                    raise RuntimeError("diverged")
+                return loss
+    """})
+    found = _run(root, select=["jit"])
+    assert sorted((f.code, f.scope) for f in found) == [
+        ("JIT107", "fit_batch"), ("JIT107", "fit_tested")]
+
+
+def test_jit_accepts_gated_and_wrapper_syncs(tmp_path):
+    # the blessed patterns: a listener-gated sync and a sync wrapper
+    # over the async sibling stay quiet
+    root = _tree(tmp_path, {"deeplearning4j_tpu/parallel/tr.py": """
+        class T:
+            def fit_batch_async(self, x):
+                self.params, loss = self._step(self.params, x)
+                return loss
+
+            def fit_batch(self, x):
+                return float(self.fit_batch_async(x))
+
+            def fit_reported(self, x, due):
+                self.params, loss = self._step(self.params, x)
+                if due:
+                    self.report(float(loss))
+                return loss
+    """})
+    assert _run(root, select=["jit"]) == []
+
+
+# ---- pass_recompile: program-ladder hazards ------------------------------
+
+def test_recompile_flags_jit_in_loop(tmp_path):
+    root = _tree(tmp_path, {"deeplearning4j_tpu/parallel/loopy.py": """
+        import jax
+
+        def train(fns, xs):
+            out = []
+            for f in fns:
+                out.append(jax.jit(f)(xs))   # fresh cache every lap
+            return out
+    """})
+    found = _run(root, select=["recompile"])
+    assert [f.code for f in found] == ["RCP201"]
+
+
+def test_recompile_flags_jit_in_per_request_method(tmp_path):
+    root = _tree(tmp_path, {"deeplearning4j_tpu/serving/hot.py": """
+        import jax
+
+        class Engine:
+            def submit(self, x):
+                return jax.jit(lambda v: v * 2)(x)
+    """})
+    found = _run(root, select=["recompile"])
+    assert "RCP201" in _codes(found)
+
+
+def test_recompile_flags_jit_over_self_closure(tmp_path):
+    root = _tree(tmp_path, {"deeplearning4j_tpu/models/m.py": """
+        import jax
+
+        class Net:
+            def build(self):
+                self._f = jax.jit(lambda x: x + self.bias)
+    """})
+    found = _run(root, select=["recompile"])
+    assert [f.code for f in found] == ["RCP202"]
+
+
+def test_recompile_flags_shape_derived_cache_key(tmp_path):
+    root = _tree(tmp_path, {"deeplearning4j_tpu/serving/keys.py": """
+        def lookup(cache, x):
+            key = f"prog-{x.shape}"          # off-ladder key
+            return cache.get(f"p-{x.shape}")  # and as a .get() arg
+    """})
+    found = _run(root, select=["recompile"])
+    assert [f.code for f in found] == ["RCP203", "RCP203"]
+
+
+def test_recompile_accepts_hoisted_jit_and_bucketed_keys(tmp_path):
+    root = _tree(tmp_path, {"deeplearning4j_tpu/serving/cold.py": """
+        import jax
+
+        def make_step(cfg):
+            def step(params, x):
+                return params, x
+            return jax.jit(step)
+
+        class Engine:
+            def __init__(self, cfg):
+                self._step = make_step(cfg)
+
+            def submit(self, x, bucket):
+                key = f"prog-{bucket}"       # ladder bucket: fine
+                return self._step, key
+    """})
+    assert _run(root, select=["recompile"]) == []
+
+
+def test_locks_condition_over_lock_is_the_same_lock(tmp_path):
+    # `self._cond = threading.Condition(self._lock)` aliases the lock:
+    # holding either IS holding the one underlying lock — no spurious
+    # wrong-lock finding on the standard CPython pattern
+    root = _tree(tmp_path, {"deeplearning4j_tpu/serving/cond.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._x = 0
+
+            def w(self):
+                with self._lock:
+                    self._x = 1
+
+            def r(self):
+                with self._cond:
+                    return self._x
+
+            def bad(self):
+                return self._x           # still flagged: no lock at all
+    """})
+    found = _run(root, select=["locks"])
+    assert [f.scope for f in found] == ["C.bad"]
+
+
+def test_locks_closure_in_locked_block_is_deferred(tmp_path):
+    # a lambda built under the lock runs LATER with no lock held — its
+    # guarded-state mutation must flag, and must not grant the guarded
+    # map false lock ownership
+    root = _tree(tmp_path, {"deeplearning4j_tpu/serving/defer.py": """
+        import threading
+
+        class D:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = []
+
+            def put(self, x):
+                with self._lock:
+                    self._queue.append(x)
+
+            def schedule(self, ex, x):
+                with self._lock:
+                    ex.submit(lambda: self._queue.append(x))
+    """})
+    found = _run(root, select=["locks"])
+    assert [(f.scope, f.symbol) for f in found] == [
+        ("D.schedule", "_queue")]
+
+
+def test_recompile_flags_jit_in_comprehension(tmp_path):
+    root = _tree(tmp_path, {"deeplearning4j_tpu/parallel/comp.py": """
+        import jax
+
+        def run_all(fns, xs):
+            return [jax.jit(f)(xs) for f in fns]
+    """})
+    found = _run(root, select=["recompile"])
+    assert [f.code for f in found] == ["RCP201"]
+
+
+def test_locks_property_getter_setter_pairs_both_scanned(tmp_path):
+    # same-named defs (property getter + setter) must each keep their
+    # own accesses — a dict keyed by name would let the getter's
+    # unlocked read vanish behind the setter's entry
+    root = _tree(tmp_path, {"deeplearning4j_tpu/serving/prop.py": """
+        import threading
+
+        class P:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._accepting = True
+
+            @property
+            def accepting(self):
+                return self._accepting   # unlocked read of guarded state
+
+            @accepting.setter
+            def accepting(self, v):
+                with self._lock:
+                    self._accepting = v
+    """})
+    found = _run(root, select=["locks"])
+    assert [(f.scope, f.symbol) for f in found] == [
+        ("P.accepting", "_accepting")]
+
+
+def test_locks_detects_annassign_lock_declarations(tmp_path):
+    # typed style `self._lock: threading.Lock = threading.Lock()` must
+    # arm the detector exactly like a plain assign
+    src = LOCKY_BAD.replace(
+        "self._lock = threading.Lock()",
+        "self._lock: threading.Lock = threading.Lock()")
+    root = _tree(tmp_path, {"deeplearning4j_tpu/serving/ledger.py": src})
+    found = _run(root, select=["locks"])
+    assert [f.scope for f in found] == ["Ledger.peek"]
+
+
+# ---- pass_excepts: broad handlers through the framework ------------------
+
+def test_excepts_relaxed_and_strict_through_framework(tmp_path):
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/ml/loose.py": """
+            try:
+                pass
+            except Exception:
+                pass
+        """,
+        "deeplearning4j_tpu/serving/sneaky.py": """
+            try:
+                pass
+            except Exception:  # noqa: BLE001 — smuggled catch-all
+                pass
+        """,
+        "deeplearning4j_tpu/ml/fine.py": """
+            try:
+                pass
+            except (OSError, ValueError):
+                pass
+            try:
+                pass
+            except Exception:  # noqa: BLE001 — justified fallback
+                pass
+        """})
+    found = _run(root, select=["excepts"])
+    by_code = {f.code: f for f in found}
+    assert set(by_code) == {"BLE001", "BLE002"}
+    assert by_code["BLE001"].path.endswith("loose.py")
+    # strict mode: the pragma did NOT save the serving/ handler
+    assert by_code["BLE002"].path.endswith("sneaky.py")
+
+
+def test_excepts_comma_list_covers_but_bare_noqa_does_not(tmp_path):
+    # `# noqa: LCK101,BLE001` names the bug class -> covered; a bare
+    # `# noqa` (left for some other tool) must NOT smuggle a broad
+    # handler — the justification has to say BLE001
+    root = _tree(tmp_path, {"deeplearning4j_tpu/ml/pragmas.py": """
+        try:
+            pass
+        except Exception:  # noqa: LCK101,BLE001 — two-code justification
+            pass
+        try:
+            pass
+        except Exception:  # noqa
+            pass
+    """})
+    found = _run(root, select=["excepts"])
+    assert len(found) == 1 and found[0].code == "BLE001"
+    assert "# noqa" in found[0].message          # the bare-noqa handler
+    assert "BLE001" not in root.joinpath(
+        "deeplearning4j_tpu/ml/pragmas.py").read_text().splitlines()[
+        found[0].line - 1]
+
+
+# ---- engine: pragma / select / baseline ----------------------------------
+
+def test_bare_noqa_and_coded_noqa_cover_codes(tmp_path):
+    root = _tree(tmp_path, {"deeplearning4j_tpu/serving/p.py": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0
+                self._y = 0
+
+            def w(self):
+                with self._lock:
+                    self._x = 1
+                    self._y = 1
+
+            def r(self):
+                a = self._x  # noqa
+                b = self._y  # noqa: JIT101 — wrong code, no cover
+                return a + b
+    """})
+    found = _run(root, select=["locks"])
+    assert [f.symbol for f in found] == ["_y"]
+
+
+def test_select_by_pass_name_and_code_prefix(tmp_path):
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/serving/ledger.py": LOCKY_BAD,
+        "deeplearning4j_tpu/nn/steps.py": JITTY_BAD})
+    assert _codes(_run(root, select=["locks"])) == ["LCK101"]
+    assert "JIT104" in _codes(_run(root, select=["JIT"]))
+    both = _run(root, select=["locks", "jit"])
+    assert "LCK101" in _codes(both) and "JIT101" in _codes(both)
+
+
+def test_select_typo_is_an_error_not_a_green_gate(tmp_path):
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/serving/ledger.py": LOCKY_BAD})
+    with pytest.raises(ValueError, match="matched no pass"):
+        _run(root, select=["lock"])   # typo for "locks"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dl4jlint", str(root),
+         "--select", "lock"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert proc.returncode == 2
+    assert "matched no pass" in proc.stderr
+
+
+def test_baseline_freezes_old_but_fails_new(tmp_path):
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/serving/ledger.py": LOCKY_BAD})
+    first = _run(root)
+    baseline = engine.baseline_counts(first)
+    # the frozen finding no longer reports as new...
+    assert engine.new_findings(first, baseline) == []
+    # ...an injected NEW finding (different method) does
+    src = textwrap.dedent(LOCKY_BAD) + (
+        "\n    def peek2(self):\n        return self._count\n")
+    (root / "deeplearning4j_tpu/serving/ledger.py").write_text(src)
+    second = _run(root)
+    new = engine.new_findings(second, baseline)
+    assert [f.scope for f in new] == ["Ledger.peek2"]
+    # and fixing the original while keeping the baseline entry is fine
+    # (a shrunken key is satisfied, never required)
+    (root / "deeplearning4j_tpu/serving/ledger.py").write_text(
+        textwrap.dedent(LOCKY_GOOD))
+    assert engine.new_findings(_run(root), baseline) == []
+
+
+def test_baseline_render_is_sorted_and_round_trips(tmp_path):
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/serving/ledger.py": LOCKY_BAD,
+        "deeplearning4j_tpu/nn/steps.py": JITTY_BAD})
+    findings = _run(root)
+    text = engine.render_baseline(findings)
+    # stable: rendering twice (and after a reload) is byte-identical
+    assert text == engine.render_baseline(list(findings))
+    path = tmp_path / "b.json"
+    path.write_text(text)
+    loaded = engine.load_baseline(path)
+    assert loaded == engine.baseline_counts(findings)
+    assert list(json.loads(text)["findings"]) == sorted(loaded)
+    assert engine.new_findings(findings, loaded) == []
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/ml/broken.py": "def oops(:\n"})
+    found = _run(root)
+    assert [f.code for f in found] == ["SYN001"]
+
+
+# ---- the real-tree gate ---------------------------------------------------
+
+def test_tree_has_zero_new_findings_within_budget():
+    """THE tier-1 gate: every finding in the real package is either
+    fixed or consciously frozen in lint_baseline.json — and the whole
+    four-pass sweep stays cheap enough to keep in tier-1 (< 10s; ~1s
+    observed)."""
+    t0 = time.perf_counter()
+    findings = engine.run_passes(REPO)
+    elapsed = time.perf_counter() - t0
+    baseline = engine.load_baseline()
+    new = engine.new_findings(findings, baseline)
+    assert new == [], "new lint findings:\n" + "\n".join(
+        f.render() for f in new)
+    assert elapsed < 10.0, f"dl4jlint sweep took {elapsed:.1f}s"
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/serving/ledger.py": LOCKY_BAD})
+    env_cmd = [sys.executable, "-m", "tools.dl4jlint", str(root),
+               "--no-baseline", "--json"]
+    proc = subprocess.run(env_cmd, capture_output=True, text=True,
+                          cwd=str(REPO))
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["new"][0]["code"] == "LCK101"
+    # the real tree against the committed baseline exits 0
+    proc = subprocess.run([sys.executable, "-m", "tools.dl4jlint"],
+                          capture_output=True, text=True, cwd=str(REPO))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_baseline_update_cli_round_trips(tmp_path):
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/serving/ledger.py": LOCKY_BAD})
+    bpath = tmp_path / "base.json"
+    cmd = [sys.executable, "-m", "tools.dl4jlint", str(root),
+           "--baseline", str(bpath)]
+    proc = subprocess.run(cmd + ["--baseline-update"],
+                          capture_output=True, text=True, cwd=str(REPO))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    first = bpath.read_text()
+    # now clean against its own baseline; update again -> byte-stable
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          cwd=str(REPO))
+    assert proc.returncode == 0
+    subprocess.run(cmd + ["--baseline-update"], capture_output=True,
+                   text=True, cwd=str(REPO))
+    assert bpath.read_text() == first

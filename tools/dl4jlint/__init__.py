@@ -1,0 +1,28 @@
+"""dl4jlint: first-party static analysis for deeplearning4j_tpu.
+
+A stdlib-only, AST-based lint framework (ISSUE-11) generalizing the
+pattern `tools/lint_excepts.py` proved: a bespoke pass in tier-1 keeps a
+whole bug class extinct.  Four passes ship today:
+
+- ``pass_locks``    (LCK1xx) — lock-discipline race detector
+- ``pass_jit``      (JIT1xx) — host-sync / purity inside jitted code
+- ``pass_recompile``(RCP2xx) — program-ladder recompile hazards
+- ``pass_excepts``  (BLE0xx) — broad exception handlers
+
+``python -m tools.dl4jlint`` runs them all against the package; any
+finding not frozen in ``lint_baseline.json`` fails (and fails tier-1 via
+tests/test_lint.py).  See docs/static-analysis.md.
+"""
+
+from .engine import (  # noqa: F401
+    Finding,
+    FileContext,
+    LintPass,
+    default_passes,
+    run_passes,
+    load_baseline,
+    baseline_counts,
+    new_findings,
+    render_baseline,
+    BASELINE_PATH,
+)

@@ -1,0 +1,114 @@
+"""``python -m tools.dl4jlint`` — run the lint passes against the tree.
+
+Exit 0 when every finding is frozen in the baseline, 1 otherwise.
+
+    python -m tools.dl4jlint                  # all passes, baselined
+    python -m tools.dl4jlint --select locks   # one pass (or code prefix)
+    python -m tools.dl4jlint --json           # machine-readable findings
+    python -m tools.dl4jlint --no-baseline    # raw findings, no freeze
+    python -m tools.dl4jlint --baseline-update  # rewrite the freeze file
+    python -m tools.dl4jlint --list-passes
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from . import engine
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.dl4jlint",
+        description="first-party static analysis for deeplearning4j_tpu")
+    p.add_argument("root", nargs="?", default=None,
+                   help="repo root (default: the checkout this file "
+                        "lives in)")
+    p.add_argument("--select", action="append", default=None,
+                   metavar="PASS|CODE",
+                   help="comma-separated pass names or code prefixes "
+                        "(locks, jit, recompile, excepts, LCK, JIT101, "
+                        "...); repeatable")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as JSON on stdout")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help=f"baseline file (default: {engine.BASELINE_PATH})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--baseline-update", action="store_true",
+                   help="rewrite the baseline from the current tree "
+                        "(sorted, diff-friendly) and exit 0")
+    p.add_argument("--list-passes", action="store_true",
+                   help="print the pass/code catalog and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    root = (pathlib.Path(args.root).resolve() if args.root
+            else pathlib.Path(__file__).resolve().parents[2])
+    passes = engine.default_passes()
+
+    if args.list_passes:
+        for p in passes:
+            print(f"{p.name}: {p.description}")
+            for code, desc in sorted(p.codes.items()):
+                print(f"  {code}  {desc}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [s for chunk in args.select for s in chunk.split(",")]
+    try:
+        findings = engine.run_passes(root, passes=passes, select=select)
+    except ValueError as e:
+        print(f"dl4jlint: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = (pathlib.Path(args.baseline) if args.baseline
+                     else engine.BASELINE_PATH)
+    if args.baseline_update:
+        if select:
+            print("--baseline-update refuses --select: the baseline "
+                  "freezes the FULL pass set", file=sys.stderr)
+            return 2
+        baseline_path.write_text(engine.render_baseline(findings))
+        print(f"dl4jlint: baseline updated "
+              f"({len(findings)} finding(s) frozen) -> {baseline_path}")
+        return 0
+
+    if args.no_baseline:
+        new = list(findings)
+    else:
+        new = engine.new_findings(findings,
+                                  engine.load_baseline(baseline_path))
+
+    if args.as_json:
+        print(json.dumps({
+            "root": str(root),
+            "total": len(findings),
+            "new": [{"path": f.path, "line": f.line, "col": f.col,
+                     "code": f.code, "scope": f.scope,
+                     "symbol": f.symbol, "message": f.message,
+                     "key": f.key}
+                    for f in new]}, indent=2))
+        return 1 if new else 0
+
+    if new:
+        print(f"dl4jlint: {len(new)} new finding(s) "
+              f"({len(findings)} total, "
+              f"{len(findings) - len(new)} baselined) — fix them or "
+              f"justify with a `# noqa: <CODE> — reason` pragma "
+              f"(docs/static-analysis.md):")
+        for f in new:
+            print(" ", f.render())
+        return 1
+    print(f"dl4jlint: OK ({len(findings)} baselined finding(s), 0 new)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

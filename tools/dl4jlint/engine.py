@@ -1,0 +1,234 @@
+"""The dl4jlint engine: shared file walker, pass protocol, pragma and
+baseline handling.
+
+Design (mirrors what made lint_excepts.py cheap enough for tier-1):
+
+- **One parse per file.**  The walker reads and ``ast.parse``s each
+  ``deeplearning4j_tpu/**/*.py`` once and hands every pass the same
+  `FileContext` (tree + source + line cache), so adding a pass costs one
+  AST walk, not one filesystem sweep.
+- **Pragmas.**  A finding whose source line carries ``# noqa: <CODE>``
+  (or a bare ``# noqa``) is suppressed — unless the pass marked it
+  ``respect_pragma=False`` (the serving/ strict-mode semantics from
+  lint_excepts: some bug classes must not be smuggleable by comment).
+- **Baseline.**  Pre-existing findings are frozen in
+  ``lint_baseline.json`` keyed by ``path::code::scope::symbol`` with a
+  count — deliberately NOT by line number, so unrelated edits above a
+  frozen finding don't thaw it.  Any finding whose key count exceeds the
+  baseline is NEW and fails.  ``--baseline-update`` rewrites the file
+  sorted, so its diffs review like code.
+
+Stays stdlib-only: the linter must run before (and without) jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+PACKAGE = "deeplearning4j_tpu"
+BASELINE_PATH = pathlib.Path(__file__).resolve().parent / \
+    "lint_baseline.json"
+
+# `# noqa` / `# noqa: LCK101` / `# noqa: LCK101,JIT104 — reason`
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?",
+                      re.IGNORECASE)
+
+
+def line_has_noqa(line: str, code: str, allow_bare: bool = True) -> bool:
+    """True when `line` carries a ``# noqa`` covering `code` (a bare
+    ``# noqa`` covers every code; comma lists work).  The ONE pragma
+    grammar, shared by the engine filter and passes that do their own
+    suppression.  ``allow_bare=False`` demands the explicit code — for
+    gates (BLE001) where a justification must name the bug class, so a
+    bare ``# noqa`` left for some other tool cannot smuggle one."""
+    m = _NOQA_RE.search(line)
+    if m is None:
+        return False
+    codes = m.group("codes")
+    if codes is None:
+        return allow_bare
+    wanted = {c.strip().upper() for c in codes.split(",") if c.strip()}
+    return code.upper() in wanted
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding.  ``key`` is the baseline identity: file + code
+    + lexical scope + the symbol the finding is about — line numbers are
+    display-only so baselines survive unrelated edits."""
+
+    path: str          # repo-relative posix path
+    line: int
+    col: int
+    code: str          # e.g. "LCK101"
+    scope: str         # "Class.method", "func", or "<module>"
+    symbol: str        # the attribute / call the finding is about
+    message: str
+    respect_pragma: bool = True
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.code}::{self.scope}::{self.symbol}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.code} "
+                f"[{self.scope}] {self.message}")
+
+
+@dataclass
+class FileContext:
+    """Everything a pass needs about one file, parsed exactly once."""
+
+    rel: str                    # repo-relative posix path
+    path: pathlib.Path          # absolute
+    source: str
+    tree: ast.AST
+    lines: List[str] = field(default_factory=list)
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def has_pragma(self, lineno: int, code: str) -> bool:
+        """True when `lineno` carries a ``# noqa`` that covers `code`
+        (bare noqa covers every code)."""
+        return line_has_noqa(self.line(lineno), code)
+
+
+class LintPass:
+    """Base class for a pass.  Subclasses set `name`, `codes` (code ->
+    one-line description) and implement `run(ctx)` yielding Findings.
+    The engine applies pragma suppression afterwards; passes that need
+    strict (pragma-proof) semantics emit respect_pragma=False."""
+
+    name: str = "pass"
+    description: str = ""
+    codes: Dict[str, str] = {}
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+
+def default_passes() -> List[LintPass]:
+    # imported lazily so `from tools.dl4jlint.engine import Finding`
+    # never drags every pass (and their module-level tables) in
+    from . import pass_excepts, pass_jit, pass_locks, pass_recompile
+    return [pass_locks.LockDisciplinePass(),
+            pass_jit.JitPurityPass(),
+            pass_recompile.RecompileHazardPass(),
+            pass_excepts.BroadExceptPass()]
+
+
+def iter_files(root: pathlib.Path) -> Iterator[pathlib.Path]:
+    pkg = root / PACKAGE
+    yield from sorted(pkg.rglob("*.py"))
+
+
+def _make_context(root: pathlib.Path, path: pathlib.Path):
+    """(FileContext, syntax_error_finding_or_None) for one file."""
+    rel = path.relative_to(root).as_posix()
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        # surfaced as an un-pragma-able finding rather than a crash: a
+        # file the linter cannot parse is itself a tier-1 failure
+        return FileContext(rel=rel, path=path, source=source,
+                           tree=ast.Module(body=[], type_ignores=[]),
+                           lines=source.splitlines()), Finding(
+            path=rel, line=e.lineno or 0, col=e.offset or 0,
+            code="SYN001", scope="<module>", symbol="syntax",
+            message=f"file does not parse: {e.msg}",
+            respect_pragma=False)
+    return FileContext(rel=rel, path=path, source=source, tree=tree,
+                       lines=source.splitlines()), None
+
+
+def run_passes(root: pathlib.Path,
+               passes: Optional[Sequence[LintPass]] = None,
+               select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run `passes` (default: all four) over every package file under
+    `root`.  `select` filters by pass name or code prefix (e.g.
+    ``["locks"]`` or ``["LCK"]``).  Returns pragma-filtered findings
+    sorted by (path, line, code)."""
+    passes = list(default_passes() if passes is None else passes)
+    if select:
+        sel = {s.strip().lower() for s in select if s.strip()}
+        matched = {s for s in sel for p in passes
+                   if p.name.lower() == s
+                   or any(code.lower().startswith(s) for code in p.codes)}
+        if sel - matched:
+            # a typo'd selector must fail loudly, not green-light an
+            # empty pass list forever
+            raise ValueError(
+                f"--select matched no pass: {sorted(sel - matched)} "
+                f"(passes: {[p.name for p in passes]})")
+        passes = [p for p in passes
+                  if p.name.lower() in sel
+                  or any(code.lower().startswith(s)
+                         for code in p.codes for s in sel)]
+    findings: List[Finding] = []
+    for path in iter_files(root):
+        ctx, syntax_error = _make_context(root, path)
+        if syntax_error is not None:
+            findings.append(syntax_error)
+            continue
+        for p in passes:
+            for f in p.run(ctx):
+                if f.respect_pragma and ctx.has_pragma(f.line, f.code):
+                    continue
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.col))
+    return findings
+
+
+# ---- baseline ------------------------------------------------------------
+
+def baseline_counts(findings: Iterable[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    return counts
+
+
+def load_baseline(path: pathlib.Path = BASELINE_PATH) -> Dict[str, int]:
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def render_baseline(findings: Iterable[Finding]) -> str:
+    """Sorted, diff-friendly JSON for `--baseline-update`."""
+    counts = baseline_counts(findings)
+    return json.dumps(
+        {"version": 1,
+         "comment": "frozen pre-existing findings — run "
+                    "`python -m tools.dl4jlint --baseline-update` after "
+                    "reviewing; new findings must be FIXED, not frozen",
+         "findings": {k: counts[k] for k in sorted(counts)}},
+        indent=2, sort_keys=False) + "\n"
+
+
+def new_findings(findings: Sequence[Finding],
+                 baseline: Dict[str, int]) -> List[Finding]:
+    """The findings NOT covered by the baseline.  For each key, the
+    first `baseline[key]` occurrences (by line order) are frozen; any
+    excess is new.  A baselined key that shrank is simply satisfied —
+    `--baseline-update` tightens the file."""
+    remaining = dict(baseline)
+    out: List[Finding] = []
+    for f in findings:
+        left = remaining.get(f.key, 0)
+        if left > 0:
+            remaining[f.key] = left - 1
+        else:
+            out.append(f)
+    return out

@@ -1,0 +1,322 @@
+"""JIT1xx — jit-purity / host-sync pass.
+
+A stray ``float(x)`` / ``.item()`` / ``print(x)`` inside a jitted
+program either fails at trace time or — worse, under ``jnp`` arrays
+outside jit — silently synchronizes the host with the device, the exact
+framework-level overhead class PAPERS.md 2001.04206 measures dominating
+Java DL frameworks.  Python-level RNG inside a traced function is a
+different bug with the same shape: it bakes ONE sample into the
+compiled program, so every step reuses it.
+
+The pass finds *traced* functions three ways (the idioms this repo
+actually uses, see parallel/ and nn/):
+
+1. decorated with ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``
+   / ``@jax.pmap`` / ``@functools.partial(jax.pmap, ...)``;
+2. passed by name to ``jax.jit(f)`` / ``jit(f)`` / ``pmap(f)`` /
+   ``lax.scan(f, ...)`` / ``jax.lax.scan`` / ``lax.associative_scan``
+   anywhere in the same file (lambdas passed inline count too);
+3. defined inside a ``_make_*`` factory and returned — the
+   ``self._step = jax.jit(self._make_train_step())`` idiom, where the
+   inner def IS the jitted body.
+
+Inside a traced function (and its nested defs/lambdas) it flags:
+
+- JIT101  ``float``/``int``/``bool`` on a non-static value
+- JIT102  ``.item()`` / ``.tolist()``
+- JIT103  ``np.asarray`` / ``np.array`` on a traced value
+- JIT104  ``print``
+- JIT105  ``time.*`` reads (wall-clock inside a program is a constant)
+- JIT106  Python / numpy RNG (``random.*``, ``np.random.*``)
+
+Static escapes: arguments mentioning ``.shape`` / ``.ndim`` / ``.size``
+/ ``.dtype`` / ``len(...)`` are trace-time Python values, not traced
+arrays — ``int(x.shape[0])`` is fine and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from .engine import FileContext, Finding, LintPass
+
+_JIT_NAMES = {"jit", "pmap", "vmap_jit"}
+_SCAN_NAMES = {"scan", "associative_scan"}
+_CAST_NAMES = {"float", "int", "bool", "complex"}
+_ITEM_ATTRS = {"item", "tolist"}
+_NP_MODULES = {"np", "numpy", "onp"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "aval", "sharding"}
+
+
+# the jitted-step attributes the repo actually binds (exact names plus
+# the `_jit_*` cache family) — a loose `_step`/`_jit` prefix would
+# false-positive on helpers like `_step_count` or `_jitter`
+_STEP_ATTRS = {"_step", "_step_fn", "_chunk_step", "_decode_step"}
+
+
+def _is_step_attr(attr: str) -> bool:
+    return attr in _STEP_ATTRS or attr.startswith("_jit_")
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """`jit` / `jax.jit` / `jax.pmap` as an expression."""
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    return False
+
+
+def _is_scan_ref(node: ast.AST) -> bool:
+    """`lax.scan` / `jax.lax.scan` / `lax.associative_scan`."""
+    return isinstance(node, ast.Attribute) and node.attr in _SCAN_NAMES
+
+
+def _is_partial_ref(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "partial"
+    return isinstance(node, ast.Attribute) and node.attr == "partial"
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    if _is_jit_ref(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_ref(dec.func):                # @jax.jit(static_...)
+            return True
+        if _is_partial_ref(dec.func):            # @partial(jax.jit, ...)
+            return any(_is_jit_ref(a) for a in dec.args)
+    return False
+
+
+def _mentions_static(node: ast.AST) -> bool:
+    """True when the expression reads trace-time-static metadata
+    (`x.shape`, `len(x)`, ...) — casting THAT to int is pure."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS:
+            return True
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id == "len"):
+            return True
+    return False
+
+
+def _collect_traced(tree: ast.AST) -> List[ast.AST]:
+    """Every FunctionDef / Lambda node in the file that is traced."""
+    traced: List[ast.AST] = []
+    jitted_names: Set[str] = set()
+
+    for node in ast.walk(tree):
+        # idiom 1: decorators
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_decorator_is_jit(d) for d in node.decorator_list):
+                traced.append(node)
+        # idiom 2: f passed to jit/pmap/scan
+        if isinstance(node, ast.Call) and (
+                _is_jit_ref(node.func) or _is_scan_ref(node.func)):
+            cands = list(node.args[:1])
+            for kw in node.keywords:
+                if kw.arg in ("fun", "f", "body_fun"):
+                    cands.append(kw.value)
+            for cand in cands:
+                if isinstance(cand, ast.Lambda):
+                    traced.append(cand)
+                elif isinstance(cand, ast.Name):
+                    jitted_names.add(cand.id)
+        # idiom 3: inner def returned from a _make_* factory
+        if (isinstance(node, ast.FunctionDef)
+                and node.name.startswith(("_make_", "make_"))):
+            returned = {
+                r.value.id for r in ast.walk(node)
+                if isinstance(r, ast.Return)
+                and isinstance(r.value, ast.Name)}
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.FunctionDef)
+                        and sub.name in returned):
+                    traced.append(sub)
+
+    if jitted_names:
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.FunctionDef)
+                    and node.name in jitted_names):
+                traced.append(node)
+    # dedupe while keeping order
+    seen: Set[int] = set()
+    out = []
+    for n in traced:
+        if id(n) not in seen:
+            seen.add(id(n))
+            out.append(n)
+    return out
+
+
+class JitPurityPass(LintPass):
+    name = "jit"
+    description = ("flag host syncs, I/O and Python RNG inside traced "
+                   "(jit/pmap/scan) functions")
+    codes = {
+        "JIT101": "float/int/bool cast of a traced value",
+        "JIT102": ".item()/.tolist() host sync",
+        "JIT103": "np.asarray/np.array on a traced value",
+        "JIT104": "print inside a traced function",
+        "JIT105": "wall-clock read inside a traced function",
+        "JIT106": "Python-level RNG inside a traced function",
+        "JIT107": "unconditional host sync of a jitted step's result",
+    }
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        yield from self._sync_on_step_path(ctx)
+        traced = _collect_traced(ctx.tree)
+        seen = set()        # a def nested in a traced def is walked by
+        for fn in traced:   # both — report each site exactly once
+            name = getattr(fn, "name", "<lambda>")
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for f in self._scan(ctx, name, stmt):
+                    site = (f.line, f.col, f.code)
+                    if site not in seen:
+                        seen.add(site)
+                        yield f
+
+    def _scan(self, ctx: FileContext, scope: str,
+              node: ast.AST) -> Iterator[Finding]:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            # JIT101: float(x) on a traced (non-constant, non-static) arg
+            if (isinstance(f, ast.Name) and f.id in _CAST_NAMES
+                    and sub.args
+                    and not isinstance(sub.args[0], ast.Constant)
+                    and not _mentions_static(sub.args[0])):
+                yield self._f(ctx, sub, "JIT101", scope, f.id,
+                              f"`{f.id}(...)` forces a host sync on a "
+                              f"traced value (trace-time error under "
+                              f"jit, silent device round-trip outside)")
+            # JIT102: .item() / .tolist()
+            elif isinstance(f, ast.Attribute) and f.attr in _ITEM_ATTRS:
+                yield self._f(ctx, sub, "JIT102", scope, f.attr,
+                              f"`.{f.attr}()` is a host sync — keep "
+                              f"the value on device or move it outside "
+                              f"the jitted program")
+            # JIT103: np.asarray / np.array
+            elif (isinstance(f, ast.Attribute)
+                    and f.attr in ("asarray", "array")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in _NP_MODULES):
+                yield self._f(ctx, sub, "JIT103", scope,
+                              f"{f.value.id}.{f.attr}",
+                              f"`{f.value.id}.{f.attr}` materializes a "
+                              f"traced value on host — use jnp inside "
+                              f"traced code")
+            # JIT104: print
+            elif isinstance(f, ast.Name) and f.id == "print":
+                yield self._f(ctx, sub, "JIT104", scope, "print",
+                              "`print` inside a traced function runs "
+                              "once at trace time (use "
+                              "jax.debug.print for runtime values)")
+            # JIT105: time.*
+            elif (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "time"):
+                yield self._f(ctx, sub, "JIT105", scope,
+                              f"time.{f.attr}",
+                              f"`time.{f.attr}()` inside a traced "
+                              f"function is baked in as a constant — "
+                              f"time around the dispatch, not inside "
+                              f"the program")
+            # JIT106: random.* / np.random.*
+            elif isinstance(f, ast.Attribute) and (
+                    (isinstance(f.value, ast.Name)
+                     and f.value.id == "random")
+                    or (isinstance(f.value, ast.Attribute)
+                        and f.value.attr == "random"
+                        and isinstance(f.value.value, ast.Name)
+                        and f.value.value.id in _NP_MODULES)):
+                yield self._f(ctx, sub, "JIT106", scope,
+                              f"random.{f.attr}",
+                              "Python/numpy RNG inside a traced "
+                              "function bakes ONE sample into the "
+                              "compiled program — thread a "
+                              "jax.random key instead")
+
+    # ---- JIT107: sync-on-step-path ---------------------------------------
+
+    def _sync_on_step_path(self, ctx: FileContext) -> Iterator[Finding]:
+        """The driver-side cousin of JIT101: a function that unpacks the
+        result of a jitted step (``..., loss = self._step(...)``) and
+        then UNCONDITIONALLY casts it to a Python scalar blocks the host
+        on every call — back-to-back steps can no longer pipeline on the
+        device.  The blessed patterns stay quiet: a cast behind an
+        ``if due:`` listener gate (conditional), and a sync *wrapper*
+        like ``float(self.fit_batch_async(...))`` (inline cast of a
+        call, not of an unpacked name) — the wrapper IS the sync API,
+        the hot loop is the async sibling."""
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            device_names = set()
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Attribute)
+                        and _is_step_attr(node.value.func.attr)):
+                    continue
+                for tgt in node.targets:
+                    elts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+                    device_names.update(
+                        e.id for e in elts if isinstance(e, ast.Name))
+            if not device_names:
+                continue
+            yield from self._unconditional_casts(ctx, fn, device_names)
+
+    def _unconditional_casts(self, ctx: FileContext, fn: ast.AST,
+                             device_names) -> Iterator[Finding]:
+        # "conditional" means a real branch: If/IfExp arms, and a Try's
+        # handlers/orelse.  A try BODY and a finally run every
+        # iteration — wrapping the per-step sync in try/finally (the
+        # supervisor plane's retry style) must not exempt it.
+        def walk(node, under_if: bool):
+            for field, value in ast.iter_fields(node):
+                cond = under_if
+                if (isinstance(node, (ast.If, ast.IfExp))
+                        and field != "test"):
+                    # the TEST of a branch runs every time — only the
+                    # arms are conditional
+                    cond = True
+                elif (isinstance(node, ast.Try)
+                        and field in ("handlers", "orelse")):
+                    cond = True
+                children = value if isinstance(value, list) else [value]
+                for child in children:
+                    if not isinstance(child, ast.AST):
+                        continue
+                    if (not cond
+                            and isinstance(child, ast.Call)
+                            and isinstance(child.func, ast.Name)
+                            and child.func.id in _CAST_NAMES
+                            and child.args
+                            and isinstance(child.args[0], ast.Name)
+                            and child.args[0].id in device_names):
+                        yield self._f(
+                            ctx, child, "JIT107", fn.name, child.func.id,
+                            f"`{child.func.id}({child.args[0].id})` "
+                            f"blocks the host on EVERY step — return "
+                            f"the device array (fit_batch_async "
+                            f"discipline) and sync only when a "
+                            f"listener/report is due")
+                    if not isinstance(child, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.Lambda)):
+                        yield from walk(child, cond)
+
+        yield from walk(fn, False)
+
+    @staticmethod
+    def _f(ctx: FileContext, node: ast.AST, code: str, scope: str,
+           symbol: str, message: str) -> Finding:
+        return Finding(path=ctx.rel, line=node.lineno,
+                       col=node.col_offset, code=code, scope=scope,
+                       symbol=symbol, message=message)

@@ -1,0 +1,272 @@
+"""LCK1xx — lock-discipline race detector.
+
+The serving/fleet/supervisor/obs planes (ISSUEs 4-10) put every shared
+mutable field behind an instance lock, and the runtime chaos tests
+assert the resulting ledger invariants — but nothing checked that a NEW
+field access actually lands under the lock.  This pass is the static
+shadow of those invariants:
+
+For each class (in ``serving/``, ``obs/``, ``resilience/``,
+``runtime/launcher.py``) that creates a ``threading.Lock``/``RLock``/
+``Condition``, infer the **guarded map**: for every ``self._x``
+attribute, the set of locks it is written under inside
+``with self.<lock>:`` blocks.  Then flag any access to a guarded
+attribute that holds none of its owning locks (LCK101) — outside every
+lock, or under the WRONG lock of a multi-lock class; both are exactly
+the shape of a torn read / lost update once a second thread exists.
+
+Deliberate blind spots (kept small and documented):
+
+- ``__init__`` is exempt — construction is single-threaded by contract.
+- Methods whose name ends in ``_locked`` are exempt — the repo-wide
+  convention for "caller holds the lock" helpers.
+- Attributes only ever touched outside locks never enter the guarded
+  set, so lock-free config fields (set once in ``__init__``) are quiet.
+- ``lock.acquire()/release()`` pairs are not modeled; the codebase uses
+  ``with`` exclusively, and a raw acquire is itself worth flagging by
+  eye in review.
+- Nested defs/lambdas are scanned with NO locks held: they run later,
+  on whatever thread calls them — the enclosing ``with`` guards their
+  construction, not their body.
+
+False positives (a field genuinely safe outside the lock — e.g. written
+only before the worker thread starts) carry ``# noqa: LCK101`` with a
+one-line justification, the same contract as BLE001.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from .engine import FileContext, Finding, LintPass
+
+# where the lock discipline is load-bearing (threads exist at scale)
+INCLUDE_PREFIXES = (
+    "deeplearning4j_tpu/serving/",
+    "deeplearning4j_tpu/obs/",
+    "deeplearning4j_tpu/resilience/",
+    "deeplearning4j_tpu/runtime/launcher.py",
+)
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    """threading.Lock() / Lock() / threading.Condition(lock) ..."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in _LOCK_FACTORIES
+    if isinstance(f, ast.Attribute):
+        return f.attr in _LOCK_FACTORIES
+    return False
+
+
+def _self_attr(node: ast.AST):
+    """'x' when node is `self.x`, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+# container mutations count as WRITES: the serving plane's shared state
+# is mostly deques/dicts/lists (`self._queue.append`, `.popleft()`,
+# `self._table[k] = v`), and rebinding-only modeling would exclude
+# exactly that dominant shape from the race detector
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "clear", "add", "discard", "update",
+    "setdefault", "sort", "reverse",
+}
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """Record every `self.<attr>` access in one method with WHICH locks
+    are lexically held at the access site (`with self.<lock>:` nesting).
+    Accesses: (attr, lineno, col, held_locks_frozenset, is_write).
+    Writes are rebinds (`self._x = ...`), subscript stores
+    (`self._x[k] = v`) and known mutator calls (`self._x.append(...)`).
+    """
+
+    def __init__(self, lock_attrs: Set[str]):
+        self.lock_attrs = lock_attrs
+        self.held: List[str] = []            # with-lock nesting, by name
+        self.accesses: List[Tuple[str, int, int, frozenset, bool]] = []
+        self._write_sites: Set[Tuple[int, int]] = set()
+
+    def visit_With(self, node: ast.With) -> None:
+        taken = [a for item in node.items
+                 if (a := _self_attr(item.context_expr)) in self.lock_attrs]
+        for item in node.items:
+            self.visit(item)
+        self.held.extend(taken)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - len(taken):len(self.held)]
+
+    def _record(self, attr: str, node: ast.AST, is_write: bool) -> None:
+        if is_write:
+            self._write_sites.add((node.lineno, node.col_offset))
+        self.accesses.append((attr, node.lineno, node.col_offset,
+                              frozenset(self.held), is_write))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            attr = _self_attr(f.value)
+            if attr is not None and attr not in self.lock_attrs:
+                # recorded at the `self._x` position so the inner
+                # Attribute visit below dedupes against it
+                self._record(attr, f.value, True)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            attr = _self_attr(node.value)
+            if attr is not None and attr not in self.lock_attrs:
+                self._record(attr, node.value, True)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if (attr is not None and attr not in self.lock_attrs
+                and (node.lineno, node.col_offset)
+                not in self._write_sites):
+            self._record(attr, node,
+                         isinstance(node.ctx, (ast.Store, ast.Del)))
+        self.generic_visit(node)
+
+    def _visit_deferred(self, node: ast.AST) -> None:
+        # a nested def/lambda runs LATER, on whatever thread calls it —
+        # the lexically enclosing `with self._lock:` guards its
+        # construction, not its body.  Scan the body with no locks
+        # held, so a deferred write can neither hide a race nor grant
+        # false lock ownership to the guarded map.
+        saved, self.held = self.held, []
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.held = saved
+
+    def visit_FunctionDef(self, node) -> None:
+        self._visit_deferred(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._visit_deferred(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._visit_deferred(node)
+
+
+class LockDisciplinePass(LintPass):
+    name = "locks"
+    description = ("flag reads/writes of lock-guarded `self._x` fields "
+                   "outside the lock")
+    codes = {"LCK101": "guarded attribute accessed outside its lock"}
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.rel.startswith(INCLUDE_PREFIXES):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    # ---- per-class --------------------------------------------------------
+
+    def _lock_attrs(self, cls: ast.ClassDef):
+        """(locks, alias) for the class: `locks` is every self attribute
+        assigned a Lock/RLock/Condition (plain or annotated assign);
+        `alias` maps a Condition built OVER another lock to that lock
+        (`self._cond = threading.Condition(self._lock)` — holding either
+        IS holding the one underlying lock, so wrong-lock analysis must
+        not treat them as distinct)."""
+        locks: Set[str] = set()
+        alias: Dict[str, str] = {}
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                targets = node.targets
+            elif (isinstance(node, ast.AnnAssign)   # typed style:
+                    and node.value is not None      # self._lock: Lock = ...
+                    and _is_lock_ctor(node.value)):
+                targets = [node.target]
+            else:
+                continue
+            wraps = None
+            if node.value.args:
+                wraps = _self_attr(node.value.args[0])
+            for tgt in targets:
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    locks.add(attr)
+                    if wraps is not None:
+                        alias[attr] = wraps
+        # canonicalize chains once (Condition-over-Condition is absurd
+        # but cheap to handle)
+        for a in list(alias):
+            seen = {a}
+            while alias.get(alias[a]) is not None and alias[a] not in seen:
+                seen.add(alias[a])
+                alias[a] = alias[alias[a]]
+        return locks, alias
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> Iterator[Finding]:
+        locks, alias = self._lock_attrs(cls)
+        if not locks:
+            return
+        canon = lambda h: alias.get(h, h)   # noqa: E731
+        # a LIST of (name, accesses) — not a dict — so same-named defs
+        # (property getter/setter pairs) each keep their own entry
+        per_method: List[Tuple[str, List[Tuple[str, int, int, frozenset,
+                                               bool]]]] = []
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            scanner = _MethodScanner(locks)
+            for s in stmt.body:
+                scanner.visit(s)
+            per_method.append((stmt.name, scanner.accesses))
+        # guarded maps attr -> the set of locks it is WRITTEN under
+        # (outside __init__): the class's own declaration of "this field
+        # is mutable shared state, owned by THESE locks".  Fields only
+        # ever read (config set once at construction) never enter,
+        # however often a locked block happens to read them.  Tracking
+        # the owning locks (not just "any lock") also catches the
+        # wrong-lock race: a field guarded by `_b` read under `_a` is
+        # as torn as one read under no lock at all.
+        guarded: Dict[str, Set[str]] = {}
+        for method, accesses in per_method:
+            if method == "__init__":
+                continue
+            for attr, _ln, _col, held, is_write in accesses:
+                if held and is_write:
+                    guarded.setdefault(attr, set()).update(
+                        canon(h) for h in held)
+        if not guarded:
+            return
+        for method, accesses in per_method:
+            if method == "__init__" or method.endswith("_locked"):
+                continue
+            for attr, lineno, col, held, is_write in accesses:
+                owners = guarded.get(attr)
+                if owners is None or {canon(h) for h in held} & owners:
+                    continue
+                kind = "written" if is_write else "read"
+                where = ("under " + "/".join(
+                    f"`self.{h}`" for h in sorted(held)) + " only"
+                    if held else "outside the lock")
+                owner = "/".join(f"self.{o}" for o in sorted(owners))
+                yield Finding(
+                    path=ctx.rel, line=lineno, col=col,
+                    code="LCK101",
+                    scope=f"{cls.name}.{method}",
+                    symbol=attr,
+                    message=(f"`self.{attr}` {kind} {where}, but it "
+                             f"is guarded by {owner} elsewhere in "
+                             f"{cls.name} — take that lock, rename "
+                             f"the helper `*_locked`, or justify "
+                             f"with `# noqa: LCK101`"))

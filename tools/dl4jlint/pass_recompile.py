@@ -1,0 +1,220 @@
+"""RCP2xx — recompile-hazard pass.
+
+The paged/serving plane's whole performance story rests on the
+program-ladder discipline: a FIXED set of jitted programs keyed by
+bucketed shapes, zero off-ladder compiles under traffic (the hazard
+PAPERS.md 2603.09555 designs its O(1) caching around, and what
+``obs.compilewatch`` measures at runtime).  This pass flags the three
+static shapes that defeat it:
+
+- RCP201  ``jax.jit(...)`` called inside a loop or a per-request
+  serving method — every call builds a fresh Python callable with its
+  own compile cache, so the XLA cache is defeated by construction.
+  Build jitted programs once (``__init__`` / a ``_make_*`` factory /
+  module scope) and dispatch to them.
+- RCP202  jit over a closure that captures ``self`` (``jax.jit`` of a
+  bound method, a ``lambda`` mentioning ``self``, or ``@jit`` directly
+  on a method): the captured object is invisible to the trace cache, so
+  mutating it silently serves STALE compiled state — and each
+  re-creation retraces.  Close over explicit arrays/statics instead.
+- RCP203  cache keys interpolating ``.shape`` through an f-string:
+  unbucketed shape-derived keys mint a new program per novel shape —
+  the off-ladder compile in key form.  Key by the LADDER bucket, not
+  the raw shape.
+
+Like every dl4jlint pass this is a reviewer, not a prover: real
+must-have sites (e.g. a deliberate per-policy rebuild) carry
+``# noqa: RCP20x`` with a justification, and pre-existing accepted
+sites live in the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from .engine import FileContext, Finding, LintPass
+
+# serving-plane method names that sit on the per-request path: creating
+# a jitted callable there is a per-request compile by construction
+_PER_REQUEST_METHODS = {
+    "submit", "submit_many", "generate", "handle", "infer", "predict",
+    "do_POST", "do_GET", "step", "decode_step",
+}
+
+_SERVING_PREFIX = "deeplearning4j_tpu/serving/"
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id == "jit"
+    return isinstance(f, ast.Attribute) and f.attr == "jit"
+
+
+def _mentions_self(node: ast.AST) -> bool:
+    return any(isinstance(sub, ast.Name) and sub.id == "self"
+               for sub in ast.walk(node))
+
+
+def _mentions_shape(node: ast.AST) -> bool:
+    return any(isinstance(sub, ast.Attribute) and sub.attr == "shape"
+               for sub in ast.walk(node))
+
+
+class RecompileHazardPass(LintPass):
+    name = "recompile"
+    description = ("flag jit-in-loop / jit-over-self / shape-keyed "
+                   "cache patterns that defeat the program ladder")
+    codes = {
+        "RCP201": "jax.jit built inside a loop or per-request method",
+        "RCP202": "jit closes over mutable `self` state",
+        "RCP203": "cache key interpolates a raw .shape",
+    }
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        yield from self._jit_sites(ctx)
+        yield from self._shape_keys(ctx)
+
+    # ---- RCP201 / RCP202 --------------------------------------------------
+
+    def _jit_sites(self, ctx: FileContext) -> Iterator[Finding]:
+        # walk with an explicit stack so each jit call knows its
+        # enclosing loops / function / class
+        stack: List[ast.AST] = []
+
+        def visit(node: ast.AST) -> Iterator[Finding]:
+            stack.append(node)
+            if _is_jit_call(node):
+                yield from self._check_jit_call(ctx, node, stack)
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and self._is_method(stack)
+                    and any(_is_jit_decorator(d)
+                            for d in node.decorator_list)):
+                yield Finding(
+                    path=ctx.rel, line=node.lineno, col=node.col_offset,
+                    code="RCP202", scope=self._scope(stack),
+                    symbol=node.name,
+                    message=(f"@jit on method `{node.name}` closes over "
+                             f"`self` — the trace cache cannot see "
+                             f"mutations of the captured object; jit a "
+                             f"pure function of explicit args instead"))
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child)
+            stack.pop()
+
+        yield from visit(ctx.tree)
+
+    @staticmethod
+    def _is_method(stack: List[ast.AST]) -> bool:
+        fn = stack[-1]
+        return (isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and bool(fn.args.args)
+                and fn.args.args[0].arg == "self"
+                and any(isinstance(n, ast.ClassDef) for n in stack[:-1]))
+
+    @staticmethod
+    def _scope(stack: List[ast.AST]) -> str:
+        names = [n.name for n in stack
+                 if isinstance(n, (ast.ClassDef, ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        return ".".join(names) if names else "<module>"
+
+    def _check_jit_call(self, ctx: FileContext, node: ast.Call,
+                        stack: List[ast.AST]) -> Iterator[Finding]:
+        scope = self._scope(stack[:-1])
+        # enclosing loop (for/while/comprehension) BELOW the nearest
+        # enclosing function boundary:
+        # a jit inside `def make(): for ...: jit(...)` is in the loop;
+        # a def nested inside a loop builds once per call, not per
+        # iteration of the outer loop
+        in_loop = False
+        for n in reversed(stack[:-1]):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                break
+            if isinstance(n, (ast.For, ast.While, ast.ListComp,
+                              ast.SetComp, ast.DictComp,
+                              ast.GeneratorExp)):
+                in_loop = True
+                break
+        fn = next((n for n in reversed(stack[:-1])
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))), None)
+        per_request = (ctx.rel.startswith(_SERVING_PREFIX)
+                       and fn is not None
+                       and fn.name in _PER_REQUEST_METHODS)
+        if in_loop or per_request:
+            where = ("a loop" if in_loop
+                     else f"per-request method `{fn.name}`")
+            yield Finding(
+                path=ctx.rel, line=node.lineno, col=node.col_offset,
+                code="RCP201", scope=scope, symbol="jit",
+                message=(f"jax.jit built inside {where}: each call is "
+                         f"a fresh callable with a cold compile cache "
+                         f"— hoist it to __init__ / a _make_* factory "
+                         f"and reuse the program"))
+        # RCP202: the jitted function itself captures self
+        target = node.args[0] if node.args else None
+        if target is not None:
+            captures = (
+                (isinstance(target, ast.Lambda)
+                 and _mentions_self(target))
+                or (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"))
+            if captures:
+                yield Finding(
+                    path=ctx.rel, line=node.lineno, col=node.col_offset,
+                    code="RCP202", scope=scope, symbol="jit",
+                    message=("jit over a closure capturing `self` — "
+                             "mutations of the captured object are "
+                             "invisible to the trace cache (stale "
+                             "programs) and every rebuild retraces; "
+                             "pass state as explicit arguments"))
+
+    # ---- RCP203 -----------------------------------------------------------
+
+    def _shape_keys(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            joined = None
+            # key = f"...{x.shape}..."  (target name mentions "key")
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.JoinedStr):
+                if any(isinstance(t, ast.Name) and "key" in t.id.lower()
+                       or (isinstance(t, ast.Attribute)
+                           and "key" in t.attr.lower())
+                       for t in node.targets):
+                    joined = node.value
+            # cache[f"...{x.shape}..."] / cache.get(f"...") /
+            # cache.setdefault(f"...")
+            elif isinstance(node, ast.Subscript) and isinstance(
+                    node.slice, ast.JoinedStr):
+                joined = node.slice
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("get", "setdefault")
+                    and node.args
+                    and isinstance(node.args[0], ast.JoinedStr)):
+                joined = node.args[0]
+            if joined is not None and _mentions_shape(joined):
+                yield Finding(
+                    path=ctx.rel, line=joined.lineno,
+                    col=joined.col_offset, code="RCP203",
+                    scope="<module>", symbol="shape-key",
+                    message=("cache key interpolates a raw `.shape`: "
+                             "every novel shape mints a new program "
+                             "(the off-ladder compile) — key by the "
+                             "bucket ladder instead"))
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Name):
+        return dec.id == "jit"
+    if isinstance(dec, ast.Attribute):
+        return dec.attr == "jit"
+    if isinstance(dec, ast.Call):
+        return _is_jit_decorator(dec.func)
+    return False

@@ -1,158 +1,46 @@
 #!/usr/bin/env python
 """Fail on new broad exception handlers in deeplearning4j_tpu/.
 
-A bare ``except:`` / ``except Exception:`` / ``except BaseException:``
-swallows real bugs (AttributeError from a typo looks exactly like a
-network flake) and is how the NaN-eats-the-run class of failures hides.
-The resilience subsystem narrows every handler it owns; this check keeps
-the codebase from growing new broad ones.
-
-A broad handler is allowed only when the ``except`` line carries an
-explicit ``noqa: BLE001`` pragma (with a justification comment) or the
-file has an entry in ALLOWLIST below.  Run directly or via
-tests/test_lint_excepts.py (tier-1).
+Thin shim (ISSUE-11): the pass itself now lives in
+``tools/dl4jlint/pass_excepts.py`` (the BLE0xx codes of the dl4jlint
+framework), which preserves the original semantics exactly — relaxed
+pragma mode package-wide, strict pragma-proof ceilings under serving/,
+obs/ and the process launcher.  This module re-exports the historical
+surface (`broad_handlers`, `main`, the allowlists) so existing callers
+and tests/test_lint_excepts.py keep working unchanged.
 
 Usage: python tools/lint_excepts.py [root]
+       python -m tools.dl4jlint --select excepts   (framework form)
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-# path (relative to repo root) -> max number of un-pragma'd broad handlers
-# tolerated.  Keep this EMPTY: new broad handlers should either be
-# narrowed or carry a justified `noqa: BLE001` pragma on the except line.
-ALLOWLIST: dict = {}
+if not __package__:
+    # direct-script mode (`python tools/lint_excepts.py`): make the
+    # repo root importable; as `tools.lint_excepts` it already is, and
+    # mutating sys.path on import would let repo top-level names shadow
+    # installed packages
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# Under serving/ the bar is higher (ISSUE-4): the request path is where a
-# swallowed AttributeError becomes a silent wrong answer at scale, so a
-# `noqa: BLE001` pragma alone is NOT enough — every broad handler,
-# pragma'd or not, must be accounted for here with its exact ceiling.
-# The documented sites are the group-failure isolators (a dispatch group
-# / decode step must fail its OWN requests whatever the device raised)
-# and the worker-survival backstops (the worker thread must outlive any
-# group failure, or every future submit hangs on a dead queue).
-SERVING_ALLOWLIST: dict = {
-    "deeplearning4j_tpu/serving/batcher.py": 2,  # _execute bisector +
-                                                 # _run survival backstop
-    "deeplearning4j_tpu/serving/lm.py": 1,       # _run fail-in-flight
-    "deeplearning4j_tpu/serving/fleet.py": 1,    # _FleetHandler.do_POST
-                                                 # catch-all: the fleet
-                                                 # front must keep
-                                                 # serving (500 once,
-                                                 # typed stay 4xx/503)
-    "deeplearning4j_tpu/serving/procfleet.py": 1,  # supervision-loop
-                                                   # survival backstop:
-                                                   # a bug in one sweep
-                                                   # must not end ALL
-                                                   # future restarts
-}
-SERVING_PREFIX = "deeplearning4j_tpu/serving/"
-
-# The process launcher gets the strict bar too (ISSUE-10): a swallowed
-# exception around spawn/reap/kill is how zombies and orphaned worker
-# process groups hide — no broad handlers at all, pragma'd or not.
-LAUNCHER_ALLOWLIST: dict = {}
-LAUNCHER_PREFIX = "deeplearning4j_tpu/runtime/launcher.py"
-
-# The observability plane gets the same strict bar (ISSUE-8): a
-# swallowed exception inside a metrics/trace hook silently blinds the
-# system right when something is going wrong — no broad handlers at
-# all, pragma'd or not.
-OBS_ALLOWLIST: dict = {}
-OBS_PREFIX = "deeplearning4j_tpu/obs/"
-
-# prefix -> (allowlist, label) for the strict-mode passes
-STRICT_PREFIXES = (
-    (SERVING_PREFIX, SERVING_ALLOWLIST, "SERVING_ALLOWLIST"),
-    (OBS_PREFIX, OBS_ALLOWLIST, "OBS_ALLOWLIST"),
-    (LAUNCHER_PREFIX, LAUNCHER_ALLOWLIST, "LAUNCHER_ALLOWLIST"),
+from tools.dl4jlint.pass_excepts import (  # noqa: E402,F401
+    ALLOWLIST,
+    LAUNCHER_ALLOWLIST,
+    LAUNCHER_PREFIX,
+    OBS_ALLOWLIST,
+    OBS_PREFIX,
+    PACKAGE,
+    PRAGMA,
+    SERVING_ALLOWLIST,
+    SERVING_PREFIX,
+    STRICT_PREFIXES,
+    BroadExceptPass,
+    _is_broad,
+    broad_handlers,
+    main,
 )
-
-PACKAGE = "deeplearning4j_tpu"
-PRAGMA = "noqa: BLE001"
-
-
-def _is_broad(handler: ast.ExceptHandler) -> bool:
-    """True for ``except:``, ``except Exception``, ``except BaseException``,
-    including tuple forms that contain either."""
-    t = handler.type
-    if t is None:
-        return True
-
-    def broad_name(node) -> bool:
-        return isinstance(node, ast.Name) and node.id in (
-            "Exception", "BaseException")
-
-    if isinstance(t, ast.Tuple):
-        return any(broad_name(el) for el in t.elts)
-    return broad_name(t)
-
-
-def broad_handlers(path: pathlib.Path, respect_pragma: bool = True):
-    """Yield (lineno, line) for each broad handler in `path`.  With
-    `respect_pragma` (the default), handlers whose except line carries
-    the `noqa: BLE001` pragma are skipped; `respect_pragma=False` counts
-    EVERY broad handler — the serving/ strict mode."""
-    source = path.read_text()
-    lines = source.splitlines()
-    try:
-        tree = ast.parse(source, filename=str(path))
-    except SyntaxError as e:
-        yield (e.lineno or 0, f"<syntax error: {e}>")
-        return
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and _is_broad(node):
-            line = lines[node.lineno - 1]
-            if not respect_pragma or PRAGMA not in line:
-                yield (node.lineno, line.strip())
-
-
-def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    root = pathlib.Path(argv[0]) if argv else \
-        pathlib.Path(__file__).resolve().parent.parent
-    pkg = root / PACKAGE
-    failures = []
-    for path in sorted(pkg.rglob("*.py")):
-        rel = str(path.relative_to(root))
-        strict = next(((allow, label)
-                       for prefix, allow, label in STRICT_PREFIXES
-                       if rel.startswith(prefix)), None)
-        if strict is not None:
-            # strict mode subsumes the relaxed pragma check: count EVERY
-            # broad handler (pragma'd or not) against the explicit
-            # allowlist ceiling, and report each offender once
-            allow, label = strict
-            every = list(broad_handlers(path, respect_pragma=False))
-            ceiling = allow.get(rel, 0)
-            if len(every) > ceiling:
-                for lineno, line in every[ceiling:]:
-                    failures.append(
-                        f"{rel}:{lineno}: broad except handler exceeds "
-                        f"the {label} ceiling ({ceiling}) — narrow it "
-                        f"or (if it really is a group-failure isolator) "
-                        f"raise the ceiling with a review: {line}")
-            continue
-        found = list(broad_handlers(path))
-        allowed = ALLOWLIST.get(rel, 0)
-        if len(found) > allowed:
-            for lineno, line in found[allowed:]:
-                failures.append(f"{rel}:{lineno}: broad except handler "
-                                f"without '{PRAGMA}' pragma: {line}")
-    if failures:
-        print(f"{len(failures)} broad exception handler(s) found — narrow "
-              f"the exception types (see resilience/retry.py for the "
-              f"transient-failure pattern), or justify with a "
-              f"'# {PRAGMA} — <reason>' pragma:")
-        for f in failures:
-            print(" ", f)
-        return 1
-    print("lint_excepts: OK")
-    return 0
-
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -4,7 +4,7 @@
 .PHONY: test test-serving test-precision test-fleet test-paged \
 	test-procfleet dryrun bench smoke serving-smoke bench-precision \
 	bench-fleet bench-paged bench-procfleet test-obs bench-obs \
-	obs-smoke evidence lint test-lint
+	obs-smoke evidence lint test-lint test-elastic bench-elastic
 
 # lint first: the four-pass static sweep is ~1s and fails fast on a
 # race/host-sync/recompile-hazard/broad-except finding before the
@@ -74,6 +74,17 @@ lint:
 # zero-new-findings sweep + <10s budget gate).
 test-lint:
 	python -m pytest tests/ -q -m lint
+
+# Elastic checkpoint plane only (sharded snapshots + SHA-256 integrity,
+# kill-at-every-commit-boundary atomicity, N→M topology-elastic restore,
+# corruption fallback, real-process kill-mid-save resume acceptance).
+test-elastic:
+	python -m pytest tests/ -q -m elastic
+
+# Elastic bench row: save sharded on 4 replicas, verified restore on 2 —
+# restore latency + bitwise gate + corruption-detected gate.
+bench-elastic:
+	BENCH_ONLY=elastic python bench.py
 
 # Multichip dryrun (8 virtual CPU devices) + committed evidence log in
 # EVIDENCE/. Safe under a wedged TPU tunnel (env decision precedes jax).

@@ -150,16 +150,35 @@ def cmd_train(args) -> int:
 
     divisor = 1
     if args.runtime == "spmd":
-        from deeplearning4j_tpu.parallel import DataParallelTrainer
+        import jax
+
+        from deeplearning4j_tpu.parallel import DataParallelTrainer, make_mesh
         sync_every = int(props.get("train.sync.every", args.sync_every))
         if sync_every > 1:
             # local-SGD / Hogwild-router analog: replicas step on their
             # own shard and average every N steps instead of every step
             print(f"spmd: local-SGD mode, averaging every {sync_every} "
                   f"steps")
-        runner = DataParallelTrainer(net, sync_every=sync_every)
+        mesh = None
+        if args.replicas is not None:
+            # Elastic replica count: train on the FIRST N devices — the
+            # shrunken-host restart (`-resume` restores a checkpoint
+            # saved on ANY replica count onto this mesh).
+            avail = jax.devices()
+            if not 1 <= args.replicas <= len(avail):
+                raise SystemExit(
+                    f"-replicas must be in [1, {len(avail)}] (visible "
+                    f"devices), got {args.replicas}")
+            mesh = make_mesh((args.replicas,), ("data",),
+                             devices=avail[:args.replicas])
+            print(f"spmd: elastic mesh over {args.replicas} of "
+                  f"{len(avail)} visible devices")
+        runner = DataParallelTrainer(net, mesh=mesh, sync_every=sync_every)
         divisor = runner.n_devices
     else:
+        if args.replicas is not None:
+            print("-replicas is an spmd-runtime flag; ignored under "
+                  "-runtime local")
         runner = net
     from deeplearning4j_tpu.datasets.iterators import PrefetchDataSetIterator
 
@@ -180,7 +199,7 @@ def cmd_train(args) -> int:
     ckpt_dir = (pathlib.Path(args.ckpt_dir) if args.ckpt_dir
                 else out / "ckpts")
     will_resume = False
-    if args.resilience:
+    if args.resilience or args.resume:
         from deeplearning4j_tpu.runtime.checkpoint import latest_checkpoint
 
         will_resume = latest_checkpoint(ckpt_dir) is not None
@@ -195,6 +214,20 @@ def cmd_train(args) -> int:
         # them), as does a resilience resume (sup.resume() would discard
         # the pretraining result anyway by restoring checkpoint params).
         net.pretrain(list(ds.shuffle(seed=0).batch_by(batch)), epochs=1)
+    if args.resume and not args.resilience and will_resume:
+        # Explicit crash-safe resume without full supervision: restore
+        # the newest GOOD checkpoint (checksums verified, corrupt steps
+        # skipped for the previous good one) into the runner — elastic:
+        # the saved replica count need not match this run's mesh.
+        from deeplearning4j_tpu.runtime.checkpoint import (
+            resume_train_state,
+        )
+
+        step = resume_train_state(ckpt_dir, runner)
+        print(f"resume: restored checkpoint step {step} from {ckpt_dir}")
+    elif args.resume and not args.resilience:
+        print(f"resume: no committed checkpoint under {ckpt_dir}; "
+              f"starting fresh")
     t0 = time.time()
     # Prefetch shuffles/slices/pads batch b+1 on a host thread while the
     # device trains on b; async stepping lets the device pipeline steps
@@ -1049,6 +1082,21 @@ def build_parser() -> argparse.ArgumentParser:
                          help="spmd runtime: average replicas every N "
                               "steps instead of every step (local-SGD / "
                               "Hogwild-router analog; 1 = sync SGD)")
+    p_train.add_argument("-replicas", "--replicas", type=int,
+                         default=None,
+                         help="spmd runtime: data-parallel over the "
+                              "first N visible devices (default: all) — "
+                              "the elastic restart knob: resume a "
+                              "checkpoint saved on ANY replica count "
+                              "onto N (docs/robustness.md 'Elastic "
+                              "restart')")
+    p_train.add_argument("-resume", "--resume", action="store_true",
+                         help="restore the newest GOOD checkpoint from "
+                              "-ckpt-dir before training (shard "
+                              "checksums verified; a corrupt newest "
+                              "step falls back to the previous good "
+                              "one); with -resilience this is "
+                              "automatic")
     p_train.add_argument("-resilience", "--resilience",
                          action="store_true",
                          help="supervise training: skip poison batches, "

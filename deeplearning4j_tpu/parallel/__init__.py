@@ -1,5 +1,15 @@
 from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
 from deeplearning4j_tpu.parallel.generation import beam_search, generate
 from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.partition import (
+    PartitionSpec,
+    gather_tree,
+    replicated,
+    reshard,
+    shard_tree,
+    sharded,
+)
 
-__all__ = ["make_mesh", "DataParallelTrainer", "generate", "beam_search"]
+__all__ = ["make_mesh", "DataParallelTrainer", "generate", "beam_search",
+           "PartitionSpec", "replicated", "sharded", "reshard",
+           "shard_tree", "gather_tree"]

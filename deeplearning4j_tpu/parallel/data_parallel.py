@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from deeplearning4j_tpu.parallel import partition as part_lib
 from deeplearning4j_tpu.parallel.mesh import shard_map_compat
 
 
@@ -222,8 +223,10 @@ class DataParallelTrainer:
             sc_state = update_scaler_state(scfg, sc_state, finite)
             return params, new_state, upd_state, sc_state, loss, gnorm
 
-        pspec = P()          # replicated params/state
-        dspec = P(self.axis)  # batch-sharded data
+        # ONE partition vocabulary (parallel/partition.py): replicated
+        # params/state, batch-sharded data over the replica axis.
+        pspec = part_lib.as_jax(part_lib.replicated())
+        dspec = part_lib.as_jax(part_lib.sharded(self.axis))
 
         fn = shard_map(
             shard_step,
@@ -474,13 +477,13 @@ class DataParallelTrainer:
                 new_state)
             return params, new_state, upd_shard, loss, gnorm
 
-        pspec = P()
-        dspec = P(self.axis)
+        pspec = part_lib.as_jax(part_lib.replicated())
+        dspec = part_lib.as_jax(part_lib.sharded(self.axis))
         # Optimizer-state leaves over the padded flat vector shard over
         # the axis; scalar leaves (step counters) stay replicated.
         self._opt_shard = self._init_sharded_opt_state()
         sspec = jax.tree_util.tree_map(
-            lambda a: P(self.axis) if np.shape(a) == (k,) else P(),
+            lambda a: part_lib.as_jax(self._opt_leaf_partition(a, k)),
             self._opt_shard)
         fn = shard_map(
             shard_step,
@@ -499,6 +502,67 @@ class DataParallelTrainer:
             flat, unravel = ravel_pytree(self.net.params)
             self._flat_cache = (int(flat.shape[0]), unravel)
         return self._flat_cache
+
+    def _opt_leaf_partition(self, leaf, k: int) -> part_lib.PartitionSpec:
+        """Partition of one sharded-optimizer-state leaf: the padded
+        flat [k] moments shard over the replica axis; scalar leaves
+        (step counters) replicate."""
+        if np.shape(leaf) == (k,):
+            return part_lib.sharded(self.axis, dim=0, size=k)
+        return part_lib.replicated()
+
+    def train_state_partition(self) -> dict:
+        """ONE `parallel.partition` description of where this trainer's
+        training state lives across the replica axis — the spec the
+        elastic checkpoint plane records in each snapshot manifest:
+
+        - plain sync DP: params/updater replicated (every replica holds
+          the full tree);
+        - shard_update (ZeRO-1): the live optimizer state is flat
+          moments sharded dim-0 over the data axis — but what
+          CHECKPOINTS see is the published per-layer form
+          (device-count independent), so the published spec is
+          replicated and the live layout is reported under
+          ``live_updater``;
+        - local-SGD: the per-replica stack is transient (re-stacked
+          from the published average on restore), so the published
+          spec is replicated too.
+        """
+        rep = part_lib.replicated()
+        out = {"params": rep, "updater": rep,
+               "replicas": self.n_devices, "axis": self.axis}
+        if self.shard_update and getattr(self, "_opt_shard", None) is not None:
+            k = getattr(self, "_flat_k", None)
+            out["live_updater"] = jax.tree_util.tree_map(
+                lambda a: self._opt_leaf_partition(a, k), self._opt_shard)
+        return out
+
+    def checkpoint_partition(self) -> dict:
+        """What the resilience supervisor passes to `save_checkpoint`:
+        the partition spec of the published trees plus the shard count
+        (one shard file per replica, so save IO scales with the
+        fleet)."""
+        spec = self.train_state_partition()
+        return {"shards": self.n_devices,
+                "spec": {"params": spec["params"],
+                         "updater": spec["updater"]}}
+
+    def resume(self, directory) -> "int | None":
+        """Elastic crash-safe resume: restore the newest GOOD checkpoint
+        under `directory` into this trainer — whatever replica count
+        saved it.  Checksums are verified; corrupt steps are skipped
+        (logged) in favor of the previous good one
+        (`runtime.checkpoint.load_checkpoint` semantics); the saved
+        full-tree state is adopted through `restore_train_state`, which
+        rebuilds this trainer's mode-specific carriers (sharded moments,
+        local-SGD stacks) for THIS mesh size — the N→M restore.
+        Returns the restored step, or None when the directory holds no
+        checkpoint yet (fresh start)."""
+        from deeplearning4j_tpu.runtime.checkpoint import (
+            resume_train_state,
+        )
+
+        return resume_train_state(directory, self)
 
     @staticmethod
     def _is_p_dict(node):
@@ -600,8 +664,9 @@ class DataParallelTrainer:
             return (restack(params), restack(new_state), restack(upd_state),
                     loss, gnorm)
 
-        rspec = P(self.axis)  # per-replica stacked state
-        dspec = P(self.axis)
+        # per-replica stacked state: leading replica dim over the axis
+        rspec = part_lib.as_jax(part_lib.sharded(self.axis, dim=0))
+        dspec = part_lib.as_jax(part_lib.sharded(self.axis))
         fn = shard_map(
             local_step,
             mesh=self.mesh,

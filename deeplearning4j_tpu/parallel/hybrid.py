@@ -57,14 +57,20 @@ def _master_f32(tree):
 
 
 def place_params(mesh: Mesh, tree, spec_tree):
-    """device_put a pytree with a matching pytree of PartitionSpecs
-    (PartitionSpec is itself a tuple, so flatten the spec tree with specs
-    as leaves rather than tree_map-ing the two trees together)."""
+    """device_put a pytree with a matching pytree of partition specs —
+    either vocabulary: raw `jax.sharding.PartitionSpec` leaves or the
+    package's `parallel.partition.PartitionSpec` (normalized through
+    `partition.as_jax_leaf`, the ONE spec foundation).  jax's
+    PartitionSpec is itself a tuple, so flatten the spec tree with specs
+    as leaves rather than tree_map-ing the two trees together."""
+    from deeplearning4j_tpu.parallel import partition as part_lib
+
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     specs = jax.tree_util.tree_flatten(
-        spec_tree, is_leaf=lambda x: isinstance(x, P))[0]
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, (P, part_lib.PartitionSpec)))[0]
     assert len(leaves) == len(specs), (len(leaves), len(specs))
-    placed = [jax.device_put(a, NamedSharding(mesh, s))
+    placed = [jax.device_put(a, NamedSharding(mesh, part_lib.as_jax_leaf(s)))
               for a, s in zip(leaves, specs)]
     return jax.tree_util.tree_unflatten(treedef, placed)
 

@@ -16,6 +16,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.parallel import partition as part_lib
+
 
 def make_mesh(
     shape: Optional[Tuple[int, ...]] = None,
@@ -38,12 +40,18 @@ def make_mesh(
     return Mesh(arr, tuple(axis_names))
 
 
+def named_sharding(mesh: Mesh, spec) -> NamedSharding:
+    """NamedSharding from either spec vocabulary — the package's
+    `partition.PartitionSpec` or a raw `jax.sharding.PartitionSpec`."""
+    return NamedSharding(mesh, part_lib.as_jax_leaf(spec))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
+    return named_sharding(mesh, part_lib.replicated())
 
 
 def batch_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
-    return NamedSharding(mesh, P(axis))
+    return named_sharding(mesh, part_lib.sharded(axis))
 
 
 def shard_batch(mesh: Mesh, tree, axis: str = "data"):

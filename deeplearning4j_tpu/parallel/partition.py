@@ -1,0 +1,286 @@
+"""ONE partition-spec vocabulary for the replica axis — and the pure
+redistribution primitive built on it.
+
+Before this module every parallel tier spelled its own placement:
+`data_parallel.py` wrote inline `P()`/`P("data")` pairs, `mesh.py` had
+`replicated`/`batch_sharded` wrappers, `hybrid.py` took raw
+`jax.sharding.PartitionSpec` trees.  Checkpoints could not describe HOW a
+saved tree was laid out, so a job that died on N replicas could only be
+resurrected on exactly N.  This module is the shared foundation
+("Automatic Cross-Replica Sharding of Weight Update", arXiv 2004.13336,
+motivates one spec for params/updater placement; "Memory-efficient array
+redistribution", arXiv 2112.01075, is the N→M primitive):
+
+- `PartitionSpec(axis, dim, size)` — how one pytree leaf relates to the
+  replica axis: `axis=None` means replicated (every replica holds the
+  full leaf); otherwise tensor dimension `dim` is split across mesh axis
+  `axis`, with `size` recording the TRUE global extent along `dim` (the
+  pre-padding length, so padded equal shards can be joined bitwise).
+- `split_leaf`/`join_leaf` — equal-size splitting with padded-remainder
+  handling, and its exact inverse.
+- `reshard(tree, spec, n_from, n_to)` — the pure gather→re-split
+  redistribution: leaves carried as length-`n_from` shard lists come
+  back as length-`n_to` shard lists, bitwise-identical at the full-tree
+  level for any N→M.
+- `as_jax`/`as_jax_leaf` — bridge to `jax.sharding.PartitionSpec` so the
+  SPMD trainers consult THIS vocabulary instead of ad-hoc `P` literals.
+- `spec_to_json`/`spec_from_json` — the serialized form checkpoint
+  manifests record, so a restore knows the saved topology's layout.
+
+Host-side and dependency-light on purpose: `reshard` runs on numpy
+arrays during checkpoint restore, long before any device mesh exists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as JaxP
+
+PyTree = Any
+
+# The keypath vocabulary shared with runtime/checkpoint.py: manifests
+# record leaves under these keys and `_spec_leaves` resolves specs
+# against them, so there is exactly ONE rendering of a pytree path.
+KEYPATH_SEP = "//"
+
+
+def _path_piece(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    return str(p)
+
+
+def keypath(path) -> str:
+    """One pytree keypath (from `tree_flatten_with_path`) rendered as
+    the canonical `//`-joined string."""
+    return KEYPATH_SEP.join(_path_piece(p) for p in path)
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionSpec:
+    """How one leaf is placed across the replica axis.
+
+    ``axis=None`` (the default): replicated — every replica holds the
+    full leaf.  Otherwise tensor dimension ``dim`` is split across mesh
+    axis ``axis``; ``size`` is the true global extent along ``dim``
+    before any padding (None = unknown/unpadded)."""
+
+    axis: Optional[str] = None
+    dim: Optional[int] = None
+    size: Optional[int] = None
+
+    @property
+    def is_replicated(self) -> bool:
+        return self.axis is None or self.dim is None
+
+    def to_json(self) -> dict:
+        return {"axis": self.axis, "dim": self.dim, "size": self.size}
+
+    @staticmethod
+    def from_json(d: dict) -> "PartitionSpec":
+        return PartitionSpec(axis=d.get("axis"), dim=d.get("dim"),
+                             size=d.get("size"))
+
+
+def replicated() -> PartitionSpec:
+    return PartitionSpec()
+
+
+def sharded(axis: str = "data", dim: int = 0,
+            size: Optional[int] = None) -> PartitionSpec:
+    return PartitionSpec(axis=axis, dim=int(dim), size=size)
+
+
+def is_partition_spec(obj) -> bool:
+    return isinstance(obj, PartitionSpec)
+
+
+def as_jax(spec: PartitionSpec) -> JaxP:
+    """The `jax.sharding.PartitionSpec` equivalent of one leaf spec."""
+    if spec.is_replicated:
+        return JaxP()
+    return JaxP(*([None] * spec.dim + [spec.axis]))
+
+
+def as_jax_leaf(obj) -> JaxP:
+    """Normalize either vocabulary (ours or jax's) to a jax spec — the
+    seam `hybrid.place_params` consults so spec trees may mix both."""
+    if isinstance(obj, JaxP):
+        return obj
+    if isinstance(obj, PartitionSpec):
+        return as_jax(obj)
+    raise TypeError(f"not a partition spec: {type(obj).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# leaf-level split/join (padded-remainder handling)
+
+def padded_extent(size: int, n: int) -> int:
+    """Smallest multiple of `n` >= `size` (the per-shard extent is
+    `padded_extent(size, n) // n`)."""
+    if n <= 0:
+        raise ValueError(f"shard count must be >= 1, got {n}")
+    return ((int(size) + n - 1) // n) * n
+
+
+def split_leaf(arr, n: int, dim: int = 0) -> List[np.ndarray]:
+    """Split `arr` into `n` EQUAL-shaped pieces along `dim`, zero-padding
+    the remainder (SPMD replicas need uniform shapes).  `join_leaf` with
+    the true size is the exact inverse."""
+    arr = np.asarray(arr)
+    if arr.ndim == 0:
+        raise ValueError("cannot split a 0-d leaf; mark it replicated")
+    size = arr.shape[dim]
+    total = padded_extent(size, n)
+    if total != size:
+        pad = [(0, 0)] * arr.ndim
+        pad[dim] = (0, total - size)
+        arr = np.pad(arr, pad)
+    return [np.ascontiguousarray(piece)
+            for piece in np.split(arr, n, axis=dim)]
+
+
+def join_leaf(shards: Sequence[np.ndarray], dim: int = 0,
+              size: Optional[int] = None) -> np.ndarray:
+    """Concatenate shards along `dim` and strip trailing padding down to
+    the true `size` (None = shards were unpadded)."""
+    full = np.concatenate([np.asarray(s) for s in shards], axis=dim)
+    if size is not None and full.shape[dim] != size:
+        if full.shape[dim] < size:
+            raise ValueError(
+                f"shards join to extent {full.shape[dim]} along dim "
+                f"{dim}, smaller than the recorded size {size}")
+        full = np.take(full, np.arange(int(size)), axis=dim)
+    return full
+
+
+def _is_shard_list(x) -> bool:
+    return (isinstance(x, (list, tuple)) and len(x) > 0
+            and all(isinstance(a, (np.ndarray, np.generic))
+                    or hasattr(a, "__array__") for a in x))
+
+
+def _spec_leaves(tree: PyTree, spec) -> PyTree:
+    """Resolve `spec` to a pytree matching `tree`: a single
+    PartitionSpec broadcasts over every leaf; a flat {keypath:
+    PartitionSpec} map (the `spec_from_json` form) is looked up per
+    leaf keypath (missing keys raise); anything else is assumed to be a
+    structurally matching spec pytree."""
+    if isinstance(spec, PartitionSpec):
+        return jax.tree_util.tree_map(lambda _: spec, tree,
+                                      is_leaf=_is_shard_list)
+    if (isinstance(spec, dict) and spec
+            and all(isinstance(k, str) and is_partition_spec(v)
+                    for k, v in spec.items())):
+        # flat keypath map (what a checkpoint manifest deserializes to)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            tree, is_leaf=_is_shard_list)
+        leaves = []
+        for path, _leaf in flat:
+            key = keypath(path)
+            ps = spec.get(key)
+            if ps is None:
+                raise ValueError(
+                    f"partition spec has no entry for leaf {key!r} "
+                    f"(known: {sorted(spec)[:8]}...)")
+            leaves.append(ps)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+    return spec
+
+
+def shard_tree(tree: PyTree, spec, n: int) -> PyTree:
+    """Split every leaf of `tree` into a length-`n` shard list per its
+    spec (replicated leaves become `n` references to the same array)."""
+    spec_tree = _spec_leaves(tree, spec)
+
+    def split(leaf, ps: PartitionSpec):
+        arr = np.asarray(leaf)
+        if ps.is_replicated or arr.ndim == 0:
+            return [arr] * n
+        return split_leaf(arr, n, ps.dim)
+
+    return jax.tree_util.tree_map(split, tree, spec_tree)
+
+
+def gather_tree(tree: PyTree, spec) -> PyTree:
+    """Inverse of `shard_tree`: join every shard-list leaf back into the
+    full array (replicated leaves take shard 0)."""
+    spec_tree = _spec_leaves(tree, spec)
+
+    def join(shards, ps: PartitionSpec):
+        if not _is_shard_list(shards):
+            return np.asarray(shards)
+        if ps.is_replicated:
+            return np.asarray(shards[0])
+        return join_leaf(shards, ps.dim, ps.size)
+
+    return jax.tree_util.tree_map(join, tree, spec_tree,
+                                  is_leaf=_is_shard_list)
+
+
+def reshard(tree: PyTree, spec, n_from: int, n_to: int) -> PyTree:
+    """The pure redistribution primitive (arXiv 2112.01075): a tree
+    whose leaves are length-`n_from` shard lists (the layout a checkpoint
+    saved on `n_from` replicas restores to) comes back with
+    length-`n_to` shard lists — gather along each leaf's spec'd dim
+    (stripping padding via the spec's true size), then re-split padded
+    for `n_to`.  Bitwise-identical at the full-tree level: gathering the
+    result reproduces the original arrays exactly, for any N→M
+    (including N→1 and 1→M).  Replicated leaves are never copied, just
+    re-referenced `n_to` times.
+
+    `spec` is a single `PartitionSpec` (broadcast over every leaf) or a
+    matching pytree of them.  Bare-array leaves are treated as the
+    already-gathered full value."""
+    if n_from < 1 or n_to < 1:
+        raise ValueError(f"replica counts must be >= 1, got "
+                         f"{n_from}→{n_to}")
+    spec_tree = _spec_leaves(tree, spec)
+
+    def redistribute(shards, ps: PartitionSpec):
+        if _is_shard_list(shards):
+            if len(shards) != n_from:
+                raise ValueError(
+                    f"leaf carries {len(shards)} shards, expected "
+                    f"n_from={n_from}")
+            full = (np.asarray(shards[0]) if ps.is_replicated
+                    else join_leaf(shards, ps.dim, ps.size))
+        else:
+            full = np.asarray(shards)
+        if ps.is_replicated or full.ndim == 0:
+            return [full] * n_to
+        return split_leaf(full, n_to, ps.dim)
+
+    return jax.tree_util.tree_map(redistribute, tree, spec_tree,
+                                  is_leaf=_is_shard_list)
+
+
+# ---------------------------------------------------------------------------
+# serialization (the checkpoint-manifest form)
+
+def spec_to_json(spec) -> Dict[str, dict]:
+    """Flatten a spec (single PartitionSpec or pytree of them) to the
+    JSON form checkpoint manifests record: keypath -> leaf-spec dict,
+    with the single-spec broadcast stored under "*"."""
+    if isinstance(spec, PartitionSpec):
+        return {"*": spec.to_json()}
+    flat = jax.tree_util.tree_flatten_with_path(
+        spec, is_leaf=is_partition_spec)[0]
+    return {keypath(path): leaf.to_json() for path, leaf in flat}
+
+
+def spec_from_json(d: Dict[str, dict]):
+    """Inverse of `spec_to_json`: "*" gives back the broadcast single
+    spec; otherwise a flat {keypath: PartitionSpec} map, which
+    `reshard`/`shard_tree`/`gather_tree` resolve per leaf keypath (see
+    `_spec_leaves`) — so a manifest-recorded spec drives a reshard
+    directly."""
+    if set(d) == {"*"}:
+        return PartitionSpec.from_json(d["*"])
+    return {k: PartitionSpec.from_json(v) for k, v in d.items()}

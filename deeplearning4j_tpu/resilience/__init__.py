@@ -26,14 +26,20 @@ keep going:
 from deeplearning4j_tpu.resilience.chaos import (
     ChaosConfig,
     ChaosDataSource,
+    CheckpointChaosConfig,
     FleetChaosConfig,
+    InjectedCheckpointCrash,
     InjectedDispatchFault,
     ProcessChaosConfig,
     ServingChaosConfig,
+    chaos_checkpoint,
     chaos_dispatch,
     chaos_fleet,
     chaos_procfleet,
     chaos_runner,
+    corrupt_checkpoint,
+    flip_byte,
+    truncate_file,
 )
 from deeplearning4j_tpu.resilience.faults import (
     FaultReport,
@@ -58,14 +64,20 @@ from deeplearning4j_tpu.resilience.watchdog import StepWatchdog
 __all__ = [
     "ChaosConfig",
     "ChaosDataSource",
+    "CheckpointChaosConfig",
     "FleetChaosConfig",
+    "InjectedCheckpointCrash",
     "InjectedDispatchFault",
     "ProcessChaosConfig",
     "ServingChaosConfig",
+    "chaos_checkpoint",
     "chaos_dispatch",
     "chaos_fleet",
     "chaos_procfleet",
     "chaos_runner",
+    "corrupt_checkpoint",
+    "flip_byte",
+    "truncate_file",
     "FaultReport",
     "PreemptedError",
     "SimulatedPreemption",

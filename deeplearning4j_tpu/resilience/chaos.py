@@ -50,6 +50,7 @@ deterministic and fast via the stdlib stub worker
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Optional, Sequence
 
@@ -440,3 +441,134 @@ def chaos_procfleet(supervisor,
     Returns the installed wrapper; ``.uninstall()`` restores the real
     hooks."""
     return _ProcessChaos(supervisor, config)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint-plane fault injection (ISSUE-12: the elastic checkpoint plane)
+
+
+class InjectedCheckpointCrash(RuntimeError):
+    """The typed failure `chaos_checkpoint` raises from inside
+    `save_checkpoint`'s phase hook — the deterministic stand-in for a
+    kill -9 mid-commit.  The writer does NOT clean its staging files up
+    on the way out (a real SIGKILL wouldn't), so the directory is left
+    exactly as a crash at that boundary would leave it: the previous
+    checkpoint intact, the partial one unreferenced (orphan-swept on
+    the next save)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointChaosConfig:
+    """Where to kill a checkpoint save, keyed by the writer's
+    durability phases (`runtime.checkpoint.set_phase_hook`):
+
+    - ``crash_at_phase``: phase name (or prefix, e.g. ``"shard:"`` to
+      hit the first shard-file boundary) at which the save raises
+      `InjectedCheckpointCrash`.  Phases, in order: ``begin``,
+      ``shard:<file>`` per shard written, ``meta``, ``manifest``,
+      ``commit_marker``, ``committed`` (after the atomic rename).
+    - ``crash_at_save``: which save (0-based, counted by ``begin``
+      phases) the crash applies to — later saves proceed normally, so
+      a test can bank a good step k-1 before killing step k.  Fires
+      once.
+    """
+
+    crash_at_phase: Optional[str] = None
+    crash_at_save: int = 0
+
+
+class _CheckpointChaos:
+    """Context manager installing the phase hook; counters: ``saves``
+    (begin phases seen), ``phases`` (every phase fired, in order),
+    ``crashed`` (whether the injected crash fired)."""
+
+    def __init__(self, config: CheckpointChaosConfig):
+        self.config = config
+        self.saves = -1
+        self.phases: list = []
+        self.crashed = False
+        self._prev = None
+
+    def __enter__(self) -> "_CheckpointChaos":
+        from deeplearning4j_tpu.runtime import checkpoint as ckpt_lib
+
+        self._prev = ckpt_lib.set_phase_hook(self._hook)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from deeplearning4j_tpu.runtime import checkpoint as ckpt_lib
+
+        ckpt_lib.set_phase_hook(self._prev)
+
+    def _hook(self, phase: str, path) -> None:
+        if phase == "begin":
+            self.saves += 1
+        self.phases.append(phase)
+        cfg = self.config
+        if (cfg.crash_at_phase is not None and not self.crashed
+                and self.saves == cfg.crash_at_save
+                and phase.startswith(cfg.crash_at_phase)):
+            self.crashed = True
+            raise InjectedCheckpointCrash(
+                f"chaos: checkpoint save {self.saves} killed at phase "
+                f"{phase!r}")
+
+
+def chaos_checkpoint(config: CheckpointChaosConfig) -> _CheckpointChaos:
+    """Use as a context manager:
+
+    ``with chaos_checkpoint(CheckpointChaosConfig(crash_at_phase=
+    "manifest")) as chaos: ...`` — every `save_checkpoint` inside the
+    block runs under the hook; the configured one dies mid-commit and
+    leaves its partial staging dir on disk, exactly like a kill -9."""
+    return _CheckpointChaos(config)
+
+
+def flip_byte(path, offset: int = -1) -> None:
+    """Flip (XOR 0xFF) ONE byte of `path` in place — deterministic bit
+    rot.  Negative offsets index from the end (default: last byte,
+    which for an npz sits inside the zip central directory or the last
+    array's data — both must be DETECTED, never silently loaded)."""
+    with open(path, "r+b") as f:
+        f.seek(0, 2)
+        size = f.tell()
+        pos = offset if offset >= 0 else size + offset
+        if not 0 <= pos < size:
+            raise ValueError(f"offset {offset} outside {size}-byte file")
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def truncate_file(path, keep_bytes: Optional[int] = None) -> None:
+    """Truncate `path` to `keep_bytes` (default: half its size) — the
+    torn-write / full-disk shard."""
+    size = int(os.path.getsize(path))
+    keep = size // 2 if keep_bytes is None else int(keep_bytes)
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+
+
+def corrupt_checkpoint(ckpt_dir, mode: str = "flip",
+                       tree: str = "params") -> "os.PathLike":
+    """Corrupt one shard file of a committed checkpoint: ``mode="flip"``
+    flips a byte mid-file, ``"truncate"`` halves it.  Returns the
+    corrupted path.  The elastic loader must detect either via the
+    manifest's SHA-256/size and fall back to the previous good step."""
+    import pathlib
+
+    ckpt_dir = pathlib.Path(ckpt_dir)
+    shards = sorted(ckpt_dir.glob(f"{tree}.*.npz"))
+    if not shards:
+        raise FileNotFoundError(
+            f"no {tree!r} shard files under {ckpt_dir}")
+    victim = shards[0]
+    if mode == "flip":
+        flip_byte(victim, offset=os.path.getsize(victim) // 2)
+    elif mode == "truncate":
+        truncate_file(victim)
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r} "
+                         f"(flip|truncate)")
+    return victim

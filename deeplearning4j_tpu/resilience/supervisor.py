@@ -206,31 +206,51 @@ class TrainingSupervisor:
             # rate, loss-scale events and the intervention ledger
             # survive the pod with the checkpoint
             merged["telemetry"] = self.telemetry.snapshot()
+        # Elastic plane: a runner that knows its replica layout
+        # (DataParallelTrainer.checkpoint_partition) gets sharded
+        # snapshots — one shard file per replica plus the partition
+        # spec in the manifest, so restore can land on ANY replica
+        # count.  Runners without one save single-shard v2 checkpoints.
+        spec = shards = None
+        part = getattr(self.runner, "checkpoint_partition", None)
+        if callable(part):
+            info = part()
+            spec, shards = info.get("spec"), info.get("shards")
         save_checkpoint(
             self._dir, self.step, self.net.params,
             updater_state=self._published_updater_state(),
             net_state=getattr(self.net, "state", None),
             extra=merged,
             keep=self.config.keep, score=score,
-            keep_best=self.config.keep_best)
+            keep_best=self.config.keep_best,
+            spec=spec, shards=shards)
 
-    def resume(self) -> bool:
-        """Restore the newest committed checkpoint (params, updater state,
-        step counter, lr_scale) into the runner.  Returns False when the
-        directory has no committed checkpoint yet."""
+    def resume(self, directory: Optional[os.PathLike] = None) -> bool:
+        """Restore the newest GOOD committed checkpoint (params, updater
+        state, step counter, lr_scale) into the runner — the crash-safe
+        resume entry point.  Shard checksums are verified; a corrupt
+        newest step (flipped byte, truncated shard) is rejected with a
+        logged reason and the previous good step restores instead
+        (`load_checkpoint`'s fallback ladder); when EVERY committed step
+        is corrupt the typed `CheckpointCorruptError` propagates —
+        silently starting fresh would retrain the run.  The restored
+        topology need not match this runner's replica count: the
+        full-tree restore re-adopts into whatever mesh the runner holds
+        (elastic N→M restart).  `directory` overrides the configured
+        checkpoint dir (e.g. resuming a dead fleet member's snapshots).
+        Returns False when the directory has no committed checkpoint
+        yet."""
         from deeplearning4j_tpu.runtime.checkpoint import (
-            latest_checkpoint,
-            load_checkpoint,
+            resume_train_state,
         )
 
-        ckpt = latest_checkpoint(self._dir)
-        if ckpt is None:
+        ckpt_dir = pathlib.Path(directory) if directory is not None \
+            else self._dir
+        restored = resume_train_state(ckpt_dir, self.runner,
+                                      with_extra=True)
+        if restored is None:
             return False
-        step, params, upd, extra = load_checkpoint(
-            self._dir, self.net.params, self._updater_like())
-        self.runner.restore_train_state(step, params,
-                                        self._moments_or_fresh(upd, params),
-                                        self._net_state_from(ckpt))
+        step, extra = restored
         self.net.set_lr_scale(extra.get("lr_scale", 1.0))
         self.step = step
         self.batches_consumed = int(extra.get("batches_consumed", step))
@@ -239,31 +259,19 @@ class TrainingSupervisor:
                  step, self.net._lr_scale)
         return True
 
-    def _net_state_from(self, ckpt):
-        from deeplearning4j_tpu.runtime.checkpoint import load_net_state
-
-        like = getattr(self.net, "state", None)
-        return load_net_state(ckpt, like) if like is not None else None
-
     def _moments_or_fresh(self, upd, params):
         """Updater state to restore: the checkpointed moments, or — when
         the checkpoint carried none (save_updater=False) — a FRESH init.
         Keeping the live moments instead would re-poison clean restored
-        params the moment a NaN step's momentum is applied."""
+        params the moment a NaN step's momentum is applied.  (Checkpoint
+        restores go through `runtime.checkpoint.resume_train_state`,
+        which applies the same policy; this copy serves the IN-MEMORY
+        chunk-replay snapshot, which never touches disk.)"""
         return upd if upd is not None else self.net._updater.init(params)
-
-    def _updater_like(self):
-        """A structure template for restoring updater state: the live one
-        when the net holds it, else a fresh init (a sharded trainer may
-        have cleared the net's copy)."""
-        if self.net.updater_state is not None:
-            return self.net.updater_state
-        return self.net._updater.init(self.net.params)
 
     def _rollback(self, report: FaultReport) -> None:
         from deeplearning4j_tpu.runtime.checkpoint import (
-            latest_checkpoint,
-            load_checkpoint,
+            resume_train_state,
         )
 
         self.rollbacks += 1
@@ -276,18 +284,13 @@ class TrainingSupervisor:
             raise SupervisorAbort(
                 f"rollback budget exhausted "
                 f"({self.config.max_rollbacks}): {report}", report=report)
-        ckpt = latest_checkpoint(self._dir)
-        if ckpt is None:
+        step = resume_train_state(self._dir, self.runner)
+        if step is None:
             # run() writes a step-0 checkpoint before the first step, so
             # this only happens when step() is driven by hand pre-ckpt.
             raise SupervisorAbort(
                 f"cannot roll back: no committed checkpoint under "
                 f"{self._dir}", report=report)
-        step, params, upd, _extra = load_checkpoint(
-            self._dir, self.net.params, self._updater_like())
-        self.runner.restore_train_state(step, params,
-                                        self._moments_or_fresh(upd, params),
-                                        self._net_state_from(ckpt))
         new_scale = self.net._lr_scale * self.config.lr_backoff
         self.net.set_lr_scale(new_scale)
         self.step = step

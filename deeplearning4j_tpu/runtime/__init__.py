@@ -8,6 +8,7 @@ tracking, and the Akka/YARN job control (SURVEY §2.3, §5).
 
 from deeplearning4j_tpu.runtime.checkpoint import (
     AsyncCheckpointListener,
+    CheckpointCorruptError,
     CheckpointListener,
     DiskModelSaver,
     ModelSaver,
@@ -16,10 +17,15 @@ from deeplearning4j_tpu.runtime.checkpoint import (
     load_checkpoint,
     load_model,
     load_params,
+    read_ckpt_manifest,
     read_manifest,
+    rebuild_manifest,
+    resume_train_state,
     save_checkpoint,
     save_model,
     save_params,
+    sweep_orphans,
+    verify_checkpoint,
 )
 from deeplearning4j_tpu.runtime.fused import (
     FusedTrainingDriver,
@@ -53,6 +59,12 @@ __all__ = [
     "latest_checkpoint",
     "best_checkpoint",
     "read_manifest",
+    "read_ckpt_manifest",
+    "rebuild_manifest",
+    "resume_train_state",
+    "verify_checkpoint",
+    "sweep_orphans",
+    "CheckpointCorruptError",
     "ModelSaver",
     "DiskModelSaver",
     "AsyncCheckpointListener",

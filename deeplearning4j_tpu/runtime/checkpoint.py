@@ -16,25 +16,92 @@ Parity targets (SURVEY §5 checkpoint/resume):
 Formats are dependency-free: config as JSON sidecar, tensors as `.npz` keyed
 by pytree keypath, flat vectors as raw little-endian float32 (binary) or one
 value per line (txt) — both readable outside this framework.
+
+The ELASTIC checkpoint plane (docs/robustness.md "Elastic restart"):
+train-state checkpoints are sharded snapshots — each tree split into
+per-replica shard files (`params.s00000-of-00004.npz`, ...) plus a
+per-checkpoint `MANIFEST.json` recording the save topology, the
+partition spec (`parallel/partition.py`), a SHA-256 per shard file, and
+the step.  The write is a two-phase commit: everything lands in a
+`.tmp-ckpt-*` staging directory, is fsync'd, COMMIT-marked, and then
+atomically renamed into place — a kill -9 at ANY byte offset leaves
+either the previous or the new checkpoint fully loadable, never a torn
+one.  Loads verify the recorded checksums and raise a typed
+`CheckpointCorruptError` (never a raw zipfile/np.load exception); the
+newest-first loader skips corrupt steps (logging which step was
+rejected and why) and falls back to the previous good one, so a flipped
+byte costs one checkpoint interval, not the run.  The loader restores
+any saved topology onto any replica count (N→M) by joining the shards
+back into the full tree from the manifest's per-leaf metadata —
+topology-independent by construction; `parallel/partition.py`'s
+`reshard` is the GENERAL redistribution primitive (gather → re-split)
+for consumers that want per-replica shard lists rather than the
+gathered tree.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
 import pathlib
 import re
+import shutil
 import tempfile
 import time
 import warnings
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 PyTree = Any
 
-_SEP = "//"  # keypath separator inside npz keys
+log = logging.getLogger(__name__)
+
+
+def _keypath(path) -> str:
+    """npz keys ARE `parallel.partition` keypaths (manifests record
+    partition specs under the same rendering) — one implementation,
+    owned there."""
+    from deeplearning4j_tpu.parallel.partition import keypath
+
+    return keypath(path)
+
+
+def _check_integrity(path, size: int, digest: str, expected: dict,
+                     step=None) -> None:
+    """ONE size/SHA-256 comparison against a manifest entry — shared by
+    the verify pass (`_verify_files`) and the load-on-same-read path
+    (`_load_npz_arrays`), so the same defect reports identically from
+    either."""
+    import pathlib as _pathlib
+
+    name = _pathlib.Path(path).name
+    if expected.get("bytes") is not None and size != expected["bytes"]:
+        raise CheckpointCorruptError(
+            f"shard {name} truncated: {size} bytes on disk, manifest "
+            f"records {expected['bytes']}", path=path, step=step)
+    if digest != expected.get("sha256"):
+        raise CheckpointCorruptError(
+            f"shard {name} checksum mismatch (bit rot or torn write): "
+            f"{digest[:12]}... != recorded "
+            f"{str(expected.get('sha256'))[:12]}...", path=path,
+            step=step)
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint (shard file, per-checkpoint MANIFEST, or the
+    directory's retention manifest) is corrupted, truncated, or
+    missing pieces.  Typed so recovery paths can catch it and fall back
+    to the previous good step instead of matching on raw
+    zipfile/np.load exceptions."""
+
+    def __init__(self, message: str, *, path=None, step=None):
+        super().__init__(message)
+        self.path = path
+        self.step = step
 
 
 # --------------------------------------------------------------------------
@@ -44,7 +111,7 @@ def _flatten_with_paths(tree: PyTree):
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
     out = {}
     for path, leaf in flat:
-        key = _SEP.join(_path_piece(p) for p in path)
+        key = _keypath(path)
         a = np.asarray(leaf)
         if str(a.dtype) == "bfloat16":
             # np.savez cannot round-trip ml_dtypes leaves (they reload
@@ -57,32 +124,65 @@ def _flatten_with_paths(tree: PyTree):
     return out
 
 
-def _path_piece(p) -> str:
-    if hasattr(p, "key"):
-        return str(p.key)
-    if hasattr(p, "idx"):
-        return str(p.idx)
-    return str(p)
-
-
 def tree_to_npz(path: os.PathLike, tree: PyTree) -> None:
     arrays = _flatten_with_paths(tree)
     _atomic_savez(path, arrays)
 
 
-def npz_to_tree(path: os.PathLike, like: PyTree) -> PyTree:
-    """Restore leaves into the structure of `like` (keypath-matched)."""
-    with np.load(path) as data:
-        arrays = {k: data[k] for k in data.files}
+def _load_npz_arrays(path: os.PathLike,
+                     expected: Optional[dict] = None
+                     ) -> Dict[str, np.ndarray]:
+    """np.load with the failure modes typed: a truncated or bit-rotted
+    npz surfaces as `CheckpointCorruptError`, never a raw
+    zipfile.BadZipFile / OSError / ValueError from inside numpy.
+
+    With `expected` ({sha256, bytes} from a checkpoint manifest), the
+    file is read ONCE: size and SHA-256 are checked on the same bytes
+    np.load then parses — no separate verification read."""
+    import io
+    import zipfile
+
+    path = pathlib.Path(path)
+    try:
+        data = path.read_bytes()
+    except OSError as e:
+        raise CheckpointCorruptError(
+            f"unreadable array file {path}: {type(e).__name__}: {e}",
+            path=path) from e
+    if expected is not None:
+        _check_integrity(path, len(data),
+                         hashlib.sha256(data).hexdigest(), expected)
+    try:
+        with np.load(io.BytesIO(data)) as z:
+            return {k: z[k] for k in z.files}
+    except (OSError, ValueError, KeyError, EOFError,
+            zipfile.BadZipFile) as e:
+        raise CheckpointCorruptError(
+            f"unreadable array file {path}: {type(e).__name__}: {e}",
+            path=path) from e
+
+
+def _match_into_like(arrays: Dict[str, np.ndarray], like: PyTree,
+                     origin) -> PyTree:
     leaves_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
     leaves = []
     for path_, leaf in leaves_paths:
-        key = _SEP.join(_path_piece(p) for p in path_)
+        key = _keypath(path_)
         if key not in arrays:
-            raise KeyError(f"checkpoint missing leaf {key!r}")
-        arr = arrays[key]
-        leaves.append(np.asarray(arr, dtype=np.asarray(leaf).dtype))
+            # typed, not a raw KeyError: the newest-first fallback loop
+            # must be able to skip a checkpoint saved from an older
+            # model revision and land on a compatible step
+            raise CheckpointCorruptError(
+                f"checkpoint {origin} missing leaf {key!r} (structure "
+                f"mismatch with the restore template)", path=origin)
+        leaves.append(np.asarray(arrays[key],
+                                 dtype=np.asarray(leaf).dtype))
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def npz_to_tree(path: os.PathLike, like: PyTree) -> PyTree:
+    """Restore leaves into the structure of `like` (keypath-matched)."""
+    return _match_into_like(_load_npz_arrays(path), like, path)
 
 
 def _atomic_savez(path: os.PathLike, arrays: Dict[str, np.ndarray]) -> None:
@@ -245,11 +345,187 @@ def load_params(net, path: os.PathLike, mode: str = "binary") -> None:
 
 
 # --------------------------------------------------------------------------
-# Train-state checkpoints (params + updater state + step), multi-host aware
+# Train-state checkpoints (params + updater state + step)
+#
+# Single-host (which includes every multi-DEVICE SPMD job on one host —
+# the common case): the sharded v2 format with two-phase atomic commit.
+# Multi-host: the per-host shard-file format with COMMIT barriers (each
+# host can only address its own arrays; a staging-dir rename cannot span
+# hosts), unchanged.
 
 def _host_suffix() -> str:
     idx = jax.process_index() if jax.process_count() > 1 else 0
     return f"proc{idx:05d}"
+
+
+_TMP_PREFIX = ".tmp-ckpt-"
+_ORPHAN_AGE_S = 60.0
+# Staging dirs THIS process is actively writing — the orphan sweep must
+# never reap a live write (cross-process leftovers are age-gated).
+_ACTIVE_TMP: set = set()
+
+_PHASE_HOOK = None
+
+
+def set_phase_hook(hook):
+    """Install `hook(phase: str, path)` fired between the single-host
+    writer's durability phases (`begin`, `shard:<file>`, `meta`,
+    `manifest`, `commit_marker`, `committed`).  The chaos harness uses
+    it to simulate kill -9 at every commit boundary and tests use it to
+    snapshot intermediate directory states.  Returns the previous hook;
+    pass None to uninstall."""
+    global _PHASE_HOOK
+    prev = _PHASE_HOOK
+    _PHASE_HOOK = hook
+    return prev
+
+
+def _phase(name: str, path=None) -> None:
+    hook = _PHASE_HOOK
+    if hook is not None:
+        hook(name, path)
+
+
+def _fsync_path(path: os.PathLike) -> None:
+    """fsync a file or directory by path (directory fsync makes the
+    rename/creat durable on POSIX)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _file_sha256(path: os.PathLike) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _split_flat(flat: Dict[str, np.ndarray], n: int):
+    """Split a flat keypath->array dict into `n` per-replica shard dicts
+    (dim-0, padded-remainder) plus the per-leaf metadata the manifest
+    records (true shape/dtype/split dim) so joins are bitwise exact."""
+    from deeplearning4j_tpu.parallel import partition
+
+    shards: List[dict] = [{} for _ in range(n)]
+    leaves: Dict[str, dict] = {}
+    for key, arr in flat.items():
+        if arr.ndim == 0 or n == 1:
+            shards[0][key] = arr
+            dim = None
+        else:
+            dim = 0
+            for i, piece in enumerate(partition.split_leaf(arr, n, dim)):
+                shards[i][key] = piece
+        leaves[key] = {"shape": [int(s) for s in arr.shape],
+                       "dtype": str(arr.dtype), "dim": dim}
+    return shards, leaves
+
+
+_RETIRED_RE = re.compile(rf"{re.escape(_TMP_PREFIX)}retired-(\d+)-.*")
+
+
+def _rescue_retired(directory: pathlib.Path) -> None:
+    """Heal the crash window between a re-save's two renames: the old
+    copy of step N was moved aside (`.tmp-ckpt-retired-N-*`, still a
+    COMPLETE committed checkpoint) and the new one never renamed in.
+    Rename the retired copy back so the step stays loadable — called
+    from the discovery path (`_committed_steps`) so even the FIRST load
+    after the crash sees it, not just the next save's sweep.  The
+    writer tolerates losing the race (its second rename retries over a
+    rescued copy)."""
+    try:
+        children = list(directory.iterdir())
+    except OSError:
+        return
+    for child in children:
+        m = _RETIRED_RE.fullmatch(child.name)
+        if m is None or not child.is_dir():
+            continue
+        final = directory / f"ckpt-{m.group(1)}"
+        if not final.exists() and (child / "COMMIT").exists():
+            try:
+                os.rename(child, final)
+                log.warning("rescued retired copy of checkpoint step %s "
+                            "interrupted mid-re-save", m.group(1))
+            except OSError:
+                continue  # racing writer/reader; whoever wins is fine
+
+
+def sweep_orphans(directory: os.PathLike,
+                  age_s: float = _ORPHAN_AGE_S) -> List[str]:
+    """Reap checkpoint debris a crash left behind: stale `.tmp-ckpt-*`
+    staging dirs (not actively written by this process), uncommitted
+    `ckpt-N` dirs (shards written, COMMIT never landed — the pre-v2
+    crash window), and stray mkstemp leftovers (`tmp*.npz`,
+    `*.manifest`).  Everything is age-gated (`age_s` since last mtime)
+    so a concurrent writer in another process is never raced.  Returns
+    the removed names; called by `save_checkpoint` on every save so
+    orphans cannot accumulate forever."""
+    directory = pathlib.Path(directory)
+    if not directory.exists():
+        return []
+    _rescue_retired(directory)   # rescue BEFORE reaping, never after
+    removed: List[str] = []
+    now = time.time()
+    for child in directory.iterdir():
+        try:
+            st = child.stat()
+        except OSError:
+            continue  # racing unlink
+        if now - st.st_mtime < age_s:
+            continue
+        name = child.name
+        retired = _RETIRED_RE.fullmatch(name)
+        if (retired is not None and child.is_dir()
+                and (child / "COMMIT").exists()
+                and not (directory / f"ckpt-{retired.group(1)}").exists()):
+            # sole surviving copy of its step (a re-saver died between
+            # its two renames AFTER this sweep's rescue pass ran, or
+            # rescue lost a rename race): never reap — the next
+            # load/sweep rescues it.  Note the rename-aside preserves
+            # the old dir's mtime, so the age gate alone cannot protect
+            # this case.
+            continue
+        is_stale_tmp = (name.startswith(_TMP_PREFIX)
+                        and str(child) not in _ACTIVE_TMP)
+        is_uncommitted = (child.is_dir()
+                          and re.fullmatch(r"ckpt-(\d+)", name) is not None
+                          and not (child / "COMMIT").exists())
+        is_stray = (child.is_file()
+                    and (name.endswith(".manifest")
+                         or (name.startswith("tmp")
+                             and name.endswith(".npz"))))
+        if not (is_stale_tmp or is_uncommitted or is_stray):
+            continue
+        try:
+            if child.is_dir():
+                shutil.rmtree(child)
+            else:
+                child.unlink()
+            removed.append(name)
+        except OSError:
+            continue  # racing writer/sweeper; next save retries
+    if removed:
+        log.warning("checkpoint GC swept %d orphan(s) under %s: %s",
+                    len(removed), directory, ", ".join(sorted(removed)))
+    return removed
+
+
+def _spec_as_tree_map(spec) -> Dict[str, Any]:
+    """Normalize `save_checkpoint`'s `spec` argument to a
+    {tree_name: spec} map.  A dict keyed by tree names maps through;
+    anything else is the spec for the params tree."""
+    if spec is None:
+        return {}
+    if isinstance(spec, dict) and spec and set(spec) <= {"params",
+                                                         "updater",
+                                                         "state"}:
+        return dict(spec)
+    return {"params": spec}
 
 
 def save_checkpoint(directory: os.PathLike, step: int, params: PyTree,
@@ -257,77 +533,285 @@ def save_checkpoint(directory: os.PathLike, step: int, params: PyTree,
                     extra: Optional[dict] = None,
                     keep: int = 3, score: Optional[float] = None,
                     keep_best: bool = True,
-                    net_state: Optional[PyTree] = None) -> pathlib.Path:
-    """Write checkpoint `step` under `directory/ckpt-{step}/`. Each host
-    writes its own addressable shard file; on a single host this is one
-    file. Retains the newest `keep` checkpoints; with a `score` (a loss —
-    lower is better) the directory manifest tracks the best-scoring
-    checkpoint and `keep_best=True` protects it from GC even when it
-    falls out of the newest-`keep` window.  `net_state` additionally
-    persists non-parameter layer state (batch-norm running stats) — the
+                    net_state: Optional[PyTree] = None,
+                    spec=None, shards: Optional[int] = None
+                    ) -> pathlib.Path:
+    """Write checkpoint `step` under `directory/ckpt-{step}/` as a
+    sharded snapshot: each tree (params / updater / net state) split
+    into `shards` per-replica files plus a `MANIFEST.json` recording the
+    topology, per-shard SHA-256s, the partition `spec`
+    (`parallel/partition.py` — how each leaf relates to the replica
+    axis), and the step.  The write is two-phase: staged in a
+    `.tmp-ckpt-*` dir, fsync'd, COMMIT-marked, then atomically renamed
+    into place, so a kill -9 at any point leaves the previous
+    checkpoint intact and loadable.  Retains the newest `keep`
+    checkpoints; with a `score` (a loss — lower is better) the
+    directory manifest tracks the best-scoring checkpoint and
+    `keep_best=True` protects it from GC even when it falls out of the
+    newest-`keep` window.  `net_state` additionally persists
+    non-parameter layer state (batch-norm running stats) — the
     resilience supervisor saves it so rollback/resume can't revive
-    poisoned or stale statistics."""
+    poisoned or stale statistics.
+
+    Multi-host jobs keep the per-host shard-file format (each host
+    writes only its addressable arrays; COMMIT barriers coordinate)."""
     directory = pathlib.Path(directory)
     ckpt = directory / f"ckpt-{step}"
-    ckpt.mkdir(parents=True, exist_ok=True)
-    tree_to_npz(ckpt / f"params.{_host_suffix()}.npz", params)
-    if updater_state is not None:
-        tree_to_npz(ckpt / f"updater.{_host_suffix()}.npz", updater_state)
-    if net_state is not None:
-        tree_to_npz(ckpt / f"state.{_host_suffix()}.npz", net_state)
     multi_host = jax.process_count() > 1
     if multi_host:
+        ckpt.mkdir(parents=True, exist_ok=True)
+        tree_to_npz(ckpt / f"params.{_host_suffix()}.npz", params)
+        if updater_state is not None:
+            tree_to_npz(ckpt / f"updater.{_host_suffix()}.npz",
+                        updater_state)
+        if net_state is not None:
+            tree_to_npz(ckpt / f"state.{_host_suffix()}.npz", net_state)
         # Barrier: every host's shard must be durable before anyone can
         # commit, and only host 0 writes the marker / runs GC (avoids the
         # early-COMMIT and concurrent-unlink races).
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(f"ckpt-{step}-written")
-    if not multi_host or jax.process_index() == 0:
-        meta = {"step": int(step), "processes": int(jax.process_count()),
-                "extra": extra or {},
-                "saved_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
-        if score is not None:
-            meta["score"] = float(score)
-        (ckpt / "meta.json").write_text(json.dumps(meta, indent=2))
-        # COMMIT marker makes partially-written checkpoints detectable.
-        (ckpt / "COMMIT").write_text("ok")
-        manifest = read_manifest(directory)
-        entry = {"saved_at": meta["saved_at"]}
-        if score is not None:
-            entry["score"] = float(score)
-        manifest["entries"][str(int(step))] = entry
-        best = _best_step(manifest)
-        manifest["best_step"] = best
-        protect = frozenset({best}) if (keep_best and best is not None) \
-            else frozenset()
-        removed = _gc_checkpoints(directory, keep, protect=protect)
-        for s in removed:
-            manifest["entries"].pop(str(s), None)
-        _write_manifest(directory, manifest)
-    if multi_host:
+        if jax.process_index() == 0:
+            # read the retention state BEFORE committing: after the
+            # marker lands, a missing manifest would read as corruption
+            retention = _manifest_for_update(directory)
+            meta = _ckpt_meta(step, extra, score)
+            (ckpt / "meta.json").write_text(json.dumps(meta, indent=2))
+            # COMMIT marker makes partially-written checkpoints detectable.
+            (ckpt / "COMMIT").write_text("ok")
+            _update_retention(directory, step, meta, score, keep,
+                              keep_best, retention)
         multihost_utils.sync_global_devices(f"ckpt-{step}-committed")
+        return ckpt
+
+    directory.mkdir(parents=True, exist_ok=True)
+    # (orphan sweeping happens once per save, inside _gc_checkpoints)
+    retention = _manifest_for_update(directory)
+    n = max(1, int(shards or 1))
+    _phase("begin", directory)
+    tmp = pathlib.Path(tempfile.mkdtemp(
+        prefix=f"{_TMP_PREFIX}{int(step)}-", dir=directory))
+    _ACTIVE_TMP.add(str(tmp))
+    try:
+        from deeplearning4j_tpu.parallel import partition
+
+        spec_map = _spec_as_tree_map(spec)
+        manifest: dict = {
+            "format": 2, "step": int(step),
+            "topology": {"shards": n, "processes": 1},
+            "trees": {}, "files": {},
+            "partition": {name: partition.spec_to_json(s)
+                          for name, s in spec_map.items()},
+            "saved_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
+        trees = {"params": params}
+        if updater_state is not None:
+            trees["updater"] = updater_state
+        if net_state is not None:
+            trees["state"] = net_state
+        import io
+
+        for name, tree in trees.items():
+            shard_dicts, leaves = _split_flat(_flatten_with_paths(tree), n)
+            files = []
+            for i, sd in enumerate(shard_dicts):
+                fname = f"{name}.s{i:05d}-of-{n:05d}.npz"
+                # serialize to a buffer so the recorded hash comes from
+                # the SAME bytes in one pass (no write-then-re-read)
+                buf = io.BytesIO()
+                np.savez(buf, **sd)
+                data = buf.getvalue()
+                (tmp / fname).write_bytes(data)
+                _fsync_path(tmp / fname)
+                manifest["files"][fname] = {
+                    "sha256": hashlib.sha256(data).hexdigest(),
+                    "bytes": len(data)}
+                files.append(fname)
+                _phase(f"shard:{fname}", tmp)
+            manifest["trees"][name] = {"files": files, "leaves": leaves}
+        meta = _ckpt_meta(step, extra, score)
+        (tmp / "meta.json").write_text(json.dumps(meta, indent=2))
+        _fsync_path(tmp / "meta.json")
+        _phase("meta", tmp)
+        (tmp / "MANIFEST.json").write_text(json.dumps(manifest, indent=2))
+        _fsync_path(tmp / "MANIFEST.json")
+        _phase("manifest", tmp)
+        # COMMIT last inside the staging dir: v1 readers (and the remote
+        # mirror) key committedness on this marker, and it only becomes
+        # visible with the atomic rename below anyway.
+        (tmp / "COMMIT").write_text("ok")
+        _fsync_path(tmp / "COMMIT")
+        _phase("commit_marker", tmp)
+        _fsync_path(tmp)
+        retired = None
+        if ckpt.exists():
+            # Re-save of the same step: rename the old copy ASIDE (never
+            # rmtree-then-rename — a crash in that window would destroy
+            # the only copy of the step).  The aside name carries the
+            # tmp prefix; a crash between the two renames is healed by
+            # `_rescue_retired` on the very next load or save.
+            retired = pathlib.Path(tempfile.mkdtemp(
+                prefix=f"{_TMP_PREFIX}retired-{int(step)}-",
+                dir=directory))
+            os.rmdir(retired)
+            os.rename(ckpt, retired)
+        try:
+            os.rename(tmp, ckpt)
+        except OSError:
+            if retired is None or not ckpt.exists():
+                raise
+            # a concurrent reader's `_rescue_retired` renamed the old
+            # copy back into place mid-window; retire it AGAIN (never
+            # rmtree — that reopens the destroy-the-only-copy crash
+            # window) and move the new save in
+            retired = pathlib.Path(tempfile.mkdtemp(
+                prefix=f"{_TMP_PREFIX}retired-{int(step)}-",
+                dir=directory))
+            os.rmdir(retired)
+            os.rename(ckpt, retired)
+            os.rename(tmp, ckpt)
+        _fsync_path(directory)
+        if retired is not None:
+            shutil.rmtree(retired, ignore_errors=True)
+    finally:
+        _ACTIVE_TMP.discard(str(tmp))
+    _phase("committed", ckpt)
+    _update_retention(directory, step, meta, score, keep, keep_best,
+                      retention)
     return ckpt
+
+
+def _ckpt_meta(step: int, extra: Optional[dict],
+               score: Optional[float]) -> dict:
+    meta = {"step": int(step), "processes": int(jax.process_count()),
+            "extra": extra or {},
+            "saved_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    if score is not None:
+        meta["score"] = float(score)
+    return meta
+
+
+def _update_retention(directory: pathlib.Path, step: int, meta: dict,
+                      score: Optional[float], keep: int,
+                      keep_best: bool,
+                      manifest: Optional[dict] = None) -> None:
+    if manifest is None:
+        manifest = _manifest_for_update(directory)
+    entry = {"saved_at": meta["saved_at"]}
+    if score is not None:
+        entry["score"] = float(score)
+    manifest["entries"][str(int(step))] = entry
+    best = _best_step(manifest)
+    manifest["best_step"] = best
+    protect = frozenset({best}) if (keep_best and best is not None) \
+        else frozenset()
+    removed = _gc_checkpoints(directory, keep, protect=protect)
+    for s in removed:
+        manifest["entries"].pop(str(s), None)
+    _write_manifest(directory, manifest)
 
 
 # --------------------------------------------------------------------------
 # Retention manifest: per-step scores + the best-scoring checkpoint
 
+def _committed_steps(directory: pathlib.Path) -> List[Tuple[int,
+                                                            pathlib.Path]]:
+    """(step, path) for every committed checkpoint, ascending by step."""
+    out = []
+    if not directory.exists():
+        return out
+    _rescue_retired(directory)
+    for child in directory.iterdir():
+        m = re.fullmatch(r"ckpt-(\d+)", child.name)
+        if m and (child / "COMMIT").exists():
+            out.append((int(m.group(1)), child))
+    return sorted(out)
+
+
 def read_manifest(directory: os.PathLike) -> dict:
     """The directory's retention manifest ({entries: {step: {score,
-    saved_at}}, best_step}). Missing or corrupt manifests return an empty
-    one — the manifest is an index, never the source of truth (COMMIT
-    markers are)."""
-    path = pathlib.Path(directory) / "manifest.json"
+    saved_at}}, best_step}).
+
+    Never guessed at: a CORRUPT (unparseable) manifest with committed
+    checkpoints present is REFUSED with a typed `CheckpointCorruptError`
+    naming the recovery path, `rebuild_manifest` — an empty guess would
+    forget `best_step`, and the very next save's GC would then delete
+    the best-scoring checkpoint the manifest was protecting.  A MISSING
+    manifest with committed checkpoints present is the (tiny) crash
+    window between a first save's atomic rename and its retention
+    write, so it is reconstructed in memory — losslessly, from the
+    per-checkpoint metadata, NOT guessed — with a warning.  A genuinely
+    fresh directory (no committed checkpoints) returns an empty
+    manifest."""
+    directory = pathlib.Path(directory)
+    path = directory / "manifest.json"
     empty = {"format": 1, "entries": {}, "best_step": None}
     if not path.exists():
+        if _committed_steps(directory):
+            log.warning(
+                "retention manifest %s is missing but committed "
+                "checkpoints exist (crash between commit and retention "
+                "write, or external deletion); reconstructing from the "
+                "per-checkpoint metadata", path)
+            return _reconstruct_manifest(directory)
         return empty
     try:
         m = json.loads(path.read_text())
-    except (OSError, json.JSONDecodeError):
-        return empty
-    if not isinstance(m.get("entries"), dict):
-        return empty
+        if not isinstance(m.get("entries"), dict):
+            raise ValueError("'entries' is not a mapping")
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"retention manifest {path} is corrupt ({e}); refusing to "
+            f"guess retention state — run deeplearning4j_tpu.runtime."
+            f"checkpoint.rebuild_manifest({str(directory)!r}) to "
+            f"reconstruct it from the per-checkpoint metadata",
+            path=path) from e
     return m
+
+
+def _reconstruct_manifest(directory: pathlib.Path) -> dict:
+    """The retention manifest recomputed (in memory, no write) from the
+    per-checkpoint metadata — lossless: each committed `ckpt-N/meta.json`
+    records its own score and save time."""
+    manifest = {"format": 1, "entries": {}, "best_step": None,
+                "rebuilt_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    for step, ckpt in _committed_steps(directory):
+        try:
+            meta = json.loads((ckpt / "meta.json").read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            log.warning("manifest rebuild: skipping %s (unreadable "
+                        "meta.json: %s)", ckpt.name, e)
+            continue
+        entry = {"saved_at": meta.get("saved_at")}
+        if meta.get("score") is not None:
+            entry["score"] = float(meta["score"])
+        manifest["entries"][str(step)] = entry
+    manifest["best_step"] = _best_step(manifest)
+    return manifest
+
+
+def rebuild_manifest(directory: os.PathLike) -> dict:
+    """Reconstruct the retention manifest from the per-checkpoint
+    metadata — the recovery path `read_manifest` names when the
+    directory-level `manifest.json` is corrupt.  Writes the rebuilt
+    manifest and returns it."""
+    directory = pathlib.Path(directory)
+    manifest = _reconstruct_manifest(directory)
+    _write_manifest(directory, manifest)
+    return manifest
+
+
+def _manifest_for_update(directory: pathlib.Path) -> dict:
+    """The retention manifest for a writer about to update it —
+    auto-recovers (rebuild, with a warning) where the read path refuses,
+    because a save must not wedge on a deleted manifest when the
+    per-checkpoint metadata can reconstruct it exactly."""
+    try:
+        return read_manifest(directory)
+    except CheckpointCorruptError as e:
+        log.warning("retention manifest unreadable (%s); rebuilding from "
+                    "per-checkpoint metadata", e)
+        return rebuild_manifest(directory)
 
 
 def _write_manifest(directory: pathlib.Path, manifest: dict) -> None:
@@ -351,22 +835,141 @@ def _best_step(manifest: dict) -> Optional[int]:
     return min(scored, key=lambda t: (t[0], -t[1]))[1]
 
 
+def _scored_candidates(directory: pathlib.Path) -> List[pathlib.Path]:
+    """Committed checkpoints ordered best-score-first (newest breaks
+    ties) — THE score ladder, shared by `best_checkpoint` and
+    `load_checkpoint(step="best")` so both settle identically."""
+    manifest = read_manifest(directory)
+    scored = sorted(
+        ((e["score"], -int(s)) for s, e in manifest["entries"].items()
+         if isinstance(e, dict) and "score" in e))
+    out = []
+    for _score, neg_step in scored:
+        ckpt = directory / f"ckpt-{-neg_step}"
+        if (ckpt / "COMMIT").exists():
+            out.append(ckpt)
+    return out
+
+
 def best_checkpoint(directory: os.PathLike) -> Optional[pathlib.Path]:
-    """The committed checkpoint with the best (lowest) recorded score,
-    None when no scored checkpoint exists."""
+    """The committed, INTEGRITY-VERIFIED checkpoint with the best
+    (lowest) recorded score; corrupt candidates are skipped (logging
+    which step was rejected and why) in favor of the next-best scored
+    step.  None when no loadable scored checkpoint exists."""
     directory = pathlib.Path(directory)
-    best = read_manifest(directory).get("best_step")
-    if best is None:
+    for ckpt in _scored_candidates(directory):
+        try:
+            verify_checkpoint(ckpt)
+        except CheckpointCorruptError as e:
+            log.warning("best_checkpoint: %s rejected: %s", ckpt.name, e)
+            continue
+        return ckpt
+    return None
+
+
+# --------------------------------------------------------------------------
+# loading (checksum-verified, corrupt-step fallback)
+
+def read_ckpt_manifest(ckpt: os.PathLike) -> Optional[dict]:
+    """One checkpoint's `MANIFEST.json` (topology, partition spec,
+    per-shard hashes); None for a v1 per-host checkpoint that predates
+    the sharded format.  Unparseable manifests raise
+    `CheckpointCorruptError`."""
+    ckpt = pathlib.Path(ckpt)
+    path = ckpt / "MANIFEST.json"
+    if not path.exists():
         return None
-    ckpt = directory / f"ckpt-{best}"
-    return ckpt if (ckpt / "COMMIT").exists() else None
+    try:
+        m = json.loads(path.read_text())
+        if not isinstance(m.get("trees"), dict) or "step" not in m:
+            raise ValueError("missing 'trees'/'step'")
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint manifest {path}: {e}", path=path) from e
+    return m
+
+
+def _verify_files(ckpt: pathlib.Path, manifest: dict,
+                  skip: frozenset = frozenset()) -> None:
+    """Size + SHA-256 check of every manifest-listed file not in `skip`
+    (files being loaded right now verify on their single read instead —
+    see `_load_npz_arrays(expected=)`)."""
+    for fname, info in manifest.get("files", {}).items():
+        if fname in skip:
+            continue
+        path = ckpt / fname
+        if not path.exists():
+            raise CheckpointCorruptError(
+                f"shard {fname} listed in {ckpt.name}/MANIFEST.json is "
+                f"missing", path=path, step=manifest.get("step"))
+        _check_integrity(path, path.stat().st_size, _file_sha256(path),
+                         info, step=manifest.get("step"))
+
+
+def verify_checkpoint(ckpt: os.PathLike) -> Optional[dict]:
+    """Integrity check one committed checkpoint: every file the manifest
+    records must exist with the recorded size and SHA-256 (a flipped
+    byte or truncated shard is detected HERE, before any np.load).
+    Returns the parsed manifest (None for v1 checkpoints, which carry
+    no hashes — their integrity check is the np.load itself).  Raises
+    `CheckpointCorruptError` on any mismatch."""
+    ckpt = pathlib.Path(ckpt)
+    if not (ckpt / "COMMIT").exists():
+        raise CheckpointCorruptError(
+            f"{ckpt} has no COMMIT marker (partial write)", path=ckpt)
+    manifest = read_ckpt_manifest(ckpt)
+    if manifest is None:
+        if not list(ckpt.glob("params.*.npz")):
+            raise CheckpointCorruptError(
+                f"{ckpt} has no params shard files", path=ckpt)
+        return None
+    _verify_files(ckpt, manifest)
+    return manifest
+
+
+def _join_tree_v2(ckpt: pathlib.Path, manifest: dict, name: str,
+                  like: PyTree, check: bool = False) -> Optional[PyTree]:
+    """Join one tree's shard files back into the structure of `like`
+    (bitwise: padding stripped via the manifest's recorded true
+    shapes).  `check=True` verifies each shard's recorded size and
+    SHA-256 on the same single read that loads it."""
+    from deeplearning4j_tpu.parallel import partition
+
+    info = manifest["trees"].get(name)
+    if info is None:
+        return None
+    files_meta = manifest.get("files", {})
+    shard_arrays = [
+        _load_npz_arrays(ckpt / fname,
+                         files_meta.get(fname) if check else None)
+        for fname in info["files"]]
+    full: Dict[str, np.ndarray] = {}
+    for key, lm in info["leaves"].items():
+        try:
+            if lm["dim"] is None:
+                full[key] = shard_arrays[0][key]
+            else:
+                pieces = [sd[key] for sd in shard_arrays]
+                full[key] = partition.join_leaf(
+                    pieces, lm["dim"], lm["shape"][lm["dim"]])
+        except KeyError as e:
+            raise CheckpointCorruptError(
+                f"shard files of {ckpt.name}/{name} are missing leaf "
+                f"{key!r}", path=ckpt, step=manifest.get("step")) from e
+    return _match_into_like(full, like, f"{ckpt.name}/{name}")
 
 
 def load_net_state(ckpt: os.PathLike, like: PyTree) -> Optional[PyTree]:
     """Layer state (batch-norm running stats) from a checkpoint dir, in
     the structure of `like`; None when the checkpoint predates net_state
     or none was saved."""
-    path = pathlib.Path(ckpt) / f"state.{_host_suffix()}.npz"
+    ckpt = pathlib.Path(ckpt)
+    manifest = read_ckpt_manifest(ckpt)
+    if manifest is not None:
+        # check=True: the state shards hash-verify on this read (a
+        # caller's earlier verify pass does not protect THIS read)
+        return _join_tree_v2(ckpt, manifest, "state", like, check=True)
+    path = ckpt / f"state.{_host_suffix()}.npz"
     if not path.exists():
         return None
     return npz_to_tree(path, like)
@@ -376,46 +979,158 @@ def latest_checkpoint(directory: os.PathLike) -> Optional[pathlib.Path]:
     directory = pathlib.Path(directory)
     if not directory.exists():
         return None
-    best, best_step = None, -1
-    for child in directory.iterdir():
-        m = re.fullmatch(r"ckpt-(\d+)", child.name)
-        if m and (child / "COMMIT").exists():
-            step = int(m.group(1))
-            if step > best_step:
-                best, best_step = child, step
-    return best
+    committed = _committed_steps(directory)
+    return committed[-1][1] if committed else None
+
+
+def _load_one(ckpt: pathlib.Path, params_like: PyTree,
+              updater_like: Optional[PyTree], verify: bool
+              ) -> Tuple[int, PyTree, Optional[PyTree], dict]:
+    try:
+        return _load_one_impl(ckpt, params_like, updater_like, verify)
+    except KeyError as e:
+        # malformed metadata (a meta.json without 'step', a manifest
+        # tree without 'files'/'leaves') must be TYPED so the fallback
+        # ladder can skip past it to the previous good step
+        raise CheckpointCorruptError(
+            f"malformed checkpoint metadata in {ckpt.name}: missing "
+            f"key {e}", path=ckpt) from e
+
+
+def _load_one_impl(ckpt: pathlib.Path, params_like: PyTree,
+                   updater_like: Optional[PyTree], verify: bool
+                   ) -> Tuple[int, PyTree, Optional[PyTree], dict]:
+    if verify and not (ckpt / "COMMIT").exists():
+        raise CheckpointCorruptError(
+            f"{ckpt} has no COMMIT marker (partial write)", path=ckpt)
+    manifest = read_ckpt_manifest(ckpt)
+    try:
+        meta = json.loads((ckpt / "meta.json").read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable meta.json in {ckpt}: {e}", path=ckpt) from e
+    if manifest is not None:
+        # trees being restored hash-verify on their single load read;
+        # the REST of the manifest's files (e.g. the state tree when no
+        # template asked for it) are verified separately, so the whole
+        # checkpoint is still vouched for without double IO
+        params = _join_tree_v2(ckpt, manifest, "params", params_like,
+                               check=verify)
+        if params is None:
+            raise CheckpointCorruptError(
+                f"{ckpt.name}/MANIFEST.json lists no params tree",
+                path=ckpt, step=manifest.get("step"))
+        loaded = set(manifest["trees"]["params"]["files"])
+        upd = None
+        if updater_like is not None:
+            upd = _join_tree_v2(ckpt, manifest, "updater", updater_like,
+                                check=verify)
+            if upd is not None:
+                loaded |= set(manifest["trees"]["updater"]["files"])
+        if verify:
+            _verify_files(ckpt, manifest, skip=frozenset(loaded))
+    else:  # v1 per-host format (no recorded hashes)
+        if verify and not list(ckpt.glob("params.*.npz")):
+            raise CheckpointCorruptError(
+                f"{ckpt} has no params shard files", path=ckpt)
+        params = npz_to_tree(ckpt / f"params.{_host_suffix()}.npz",
+                             params_like)
+        upd = None
+        upd_path = ckpt / f"updater.{_host_suffix()}.npz"
+        if updater_like is not None and upd_path.exists():
+            upd = npz_to_tree(upd_path, updater_like)
+    return meta["step"], params, upd, meta.get("extra", {})
 
 
 def load_checkpoint(directory: os.PathLike, params_like: PyTree,
                     updater_like: Optional[PyTree] = None,
-                    step: Optional[int] = None
+                    step: Optional[int] = None, verify: bool = True
                     ) -> Tuple[int, PyTree, Optional[PyTree], dict]:
     """Returns (step, params, updater_state, extra). With `step=None`,
-    restores the newest committed checkpoint; `step="best"` restores the
-    best-scoring one per the retention manifest."""
+    restores the newest committed checkpoint, SKIPPING corrupt ones —
+    each rejected step is logged with the reason, and the previous good
+    step loads instead, so a flipped byte or truncated shard costs one
+    checkpoint interval, not the run.  `step="best"` restores the
+    best-scoring loadable one per the retention manifest; an explicit
+    integer `step` loads exactly that step or raises (the caller named
+    a specific state — falling back silently would lie).  `verify=True`
+    (default) checks every shard's recorded SHA-256 before reading it.
+
+    Raises `FileNotFoundError` when no committed checkpoint exists, and
+    `CheckpointCorruptError` when checkpoints exist but none is
+    loadable."""
     directory = pathlib.Path(directory)
     if step == "best":
-        ckpt = best_checkpoint(directory)
+        # the shared score ladder through the SAME skip-and-log loop
+        # below: each candidate is verified exactly once, and a best
+        # candidate that fails at LOAD time (a v1 checkpoint carries no
+        # hashes for verify to catch first) still falls down the ladder
+        candidates = _scored_candidates(directory)
     elif step is not None:
         ckpt = directory / f"ckpt-{step}"
+        if not ckpt.exists() or not (ckpt / "COMMIT").exists():
+            raise FileNotFoundError(
+                f"no committed checkpoint under {directory}")
+        return _load_one(ckpt, params_like, updater_like, verify)
     else:
-        ckpt = latest_checkpoint(directory)
-    if (ckpt is None or not ckpt.exists()
-            or not (ckpt / "COMMIT").exists()):
-        raise FileNotFoundError(f"no committed checkpoint under {directory}")
-    meta = json.loads((ckpt / "meta.json").read_text())
-    params = npz_to_tree(ckpt / f"params.{_host_suffix()}.npz", params_like)
-    upd = None
-    upd_path = ckpt / f"updater.{_host_suffix()}.npz"
-    if updater_like is not None and upd_path.exists():
-        upd = npz_to_tree(upd_path, updater_like)
-    return meta["step"], params, upd, meta.get("extra", {})
+        candidates = [c for _s, c in reversed(_committed_steps(directory))]
+    rejected: List[str] = []
+    for ckpt in candidates:
+        try:
+            return _load_one(ckpt, params_like, updater_like, verify)
+        except CheckpointCorruptError as e:
+            log.warning("checkpoint %s rejected (falling back to the "
+                        "previous good step): %s", ckpt.name, e)
+            rejected.append(f"{ckpt.name}: {e}")
+    if rejected:
+        raise CheckpointCorruptError(
+            f"no loadable checkpoint under {directory} — every committed "
+            f"step failed verification: " + "; ".join(rejected),
+            path=directory)
+    raise FileNotFoundError(f"no committed checkpoint under {directory}")
+
+
+def resume_train_state(directory: os.PathLike, runner,
+                       with_extra: bool = False):
+    """Restore the newest GOOD checkpoint under `directory` into any
+    runner exposing ``restore_train_state(step, params, updater_state,
+    net_state)`` (`MultiLayerNetwork`, `DataParallelTrainer`) — the ONE
+    implementation of the load / settle-on-a-step / net_state /
+    restore sequence (`DataParallelTrainer.resume`, the CLI's
+    `-resume`, and `TrainingSupervisor.resume`/`_rollback` all
+    delegate here).  Corrupt steps are skipped for the previous good
+    one; a checkpoint carrying no updater state restores FRESH moments
+    (keeping the live ones would re-poison clean restored params the
+    moment a NaN step's momentum applies); the saved topology need not
+    match the runner's replica count (elastic N→M).  Returns the
+    restored step (or `(step, extra)` with ``with_extra=True`` so a
+    supervisor can layer lr_scale/stream bookkeeping on top), or None
+    when the directory holds no checkpoint."""
+    directory = pathlib.Path(directory)
+    if latest_checkpoint(directory) is None:
+        return None
+    net = getattr(runner, "net", runner)
+    updater_like = (net.updater_state if net.updater_state is not None
+                    else net._updater.init(net.params))
+    step, params, upd, extra = load_checkpoint(
+        directory, net.params, updater_like)
+    # net_state from the step the loader SETTLED on (it may have fallen
+    # back past a corrupt newest step)
+    net_state = None
+    if getattr(net, "state", None) is not None:
+        net_state = load_net_state(directory / f"ckpt-{step}", net.state)
+    if upd is None:
+        upd = net._updater.init(params)
+    runner.restore_train_state(step, params, upd, net_state)
+    return (step, extra) if with_extra else step
 
 
 def _gc_checkpoints(directory: pathlib.Path, keep: int,
                     protect: frozenset = frozenset()) -> list:
     """Remove all but the newest `keep` checkpoints, never touching steps
-    in `protect` (best-score retention). Returns the removed steps."""
+    in `protect` (best-score retention), and sweep crash orphans (see
+    `sweep_orphans`). Returns the removed steps."""
+    sweep_orphans(directory)
     ckpts = sorted(
         (int(m.group(1)), child)
         for child in directory.iterdir()

@@ -77,6 +77,7 @@ class _StubHandler(BaseHTTPRequestHandler):
                 "classifier": {"requests": n, "rejected": 0, "shed": 0,
                                "deadline_missed": 0, "poison_isolated": 0},
                 "uptime_s": time.monotonic() - srv.t0,
+                "replicas": srv.replicas,
                 "stub_worker": True})
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
@@ -107,6 +108,11 @@ def main(argv=None) -> int:
     ap.add_argument("--ready-delay-s", type=float, default=0.0)
     ap.add_argument("--never-ready", action="store_true")
     ap.add_argument("--boot-exit-code", type=int, default=None)
+    # elastic-restart probe: the replica count this incarnation was
+    # launched with (a real elastic training worker would size its mesh
+    # by it); echoed in /serving/stats so supervision tests can assert a
+    # resurrection came back with the REWRITTEN count
+    ap.add_argument("-replicas", "--replicas", type=int, default=None)
     args = ap.parse_args(argv)
 
     if args.boot_exit_code is not None:
@@ -124,6 +130,7 @@ def main(argv=None) -> int:
     server.ready_delay_s = float(args.ready_delay_s)
     server.never_ready = bool(args.never_ready)
     server.requests = 0
+    server.replicas = args.replicas
     server.lock = threading.Lock()
 
     stop = threading.Event()

@@ -138,6 +138,12 @@ class RestartPolicy:
       subclass it to call a remote orchestrator.  Returning True counts
       a `restart_delegations` event; either way the supervisor keeps
       probing and re-attaches when the endpoint comes back.
+    - `respawn_command(worker, command)`: rewrite the command a
+      respawn runs — the ELASTIC restart seam: an elastic training
+      worker that crashed on N replicas can resurrect on a shrunken
+      host by having its `-replicas N` rewritten (see
+      `rewrite_replicas` / `ElasticRestartPolicy`).  The base policy
+      returns the command unchanged.
     """
 
     def __init__(self, backoff_initial_s: float = 0.5,
@@ -172,6 +178,53 @@ class RestartPolicy:
 
     def restart(self, worker: "SupervisedWorker") -> bool:
         return False
+
+    def respawn_command(self, worker: "SupervisedWorker",
+                        command: List[str]) -> List[str]:
+        """The command a (re)spawn of `worker` runs; called by the
+        supervisor's `_spawn_command` on EVERY spawn (inspect
+        ``worker.incarnation``/``consecutive_crashes`` to act only on
+        respawns).  Base policy: unchanged."""
+        return command
+
+
+def rewrite_replicas(command: List[str], n: int) -> List[str]:
+    """Rewrite the `-replicas`/`--replicas` value in a worker command
+    line to `n` (appending the flag when absent) — the elastic-restart
+    rewrite a `RestartPolicy.respawn_command` applies so a training
+    worker saved on N replicas resurrects on an M-replica host.  The
+    checkpoint plane makes the count change safe: snapshots restore
+    onto any replica count (`runtime.checkpoint` N→M)."""
+    out = list(command)
+    for i, arg in enumerate(out):
+        if arg in ("-replicas", "--replicas") and i + 1 < len(out):
+            out[i + 1] = str(int(n))
+            return out
+        if arg.startswith(("-replicas=", "--replicas=")):
+            out[i] = f"{arg.split('=', 1)[0]}={int(n)}"
+            return out
+    return out + ["--replicas", str(int(n))]
+
+
+class ElasticRestartPolicy(RestartPolicy):
+    """RestartPolicy whose respawns pass a NEW replica count: the first
+    respawn (and every one after) runs the worker command with
+    `-replicas` rewritten to `replicas_after_crash` — the
+    shrunken-host resurrection.  Everything else (backoff, quarantine)
+    is inherited."""
+
+    def __init__(self, replicas_after_crash: int, **kwargs):
+        super().__init__(**kwargs)
+        if replicas_after_crash < 1:
+            raise ValueError(f"replicas_after_crash must be >= 1, got "
+                             f"{replicas_after_crash}")
+        self.replicas_after_crash = int(replicas_after_crash)
+
+    def respawn_command(self, worker: "SupervisedWorker",
+                        command: List[str]) -> List[str]:
+        if worker.incarnation == 0:      # first spawn: as configured
+            return command
+        return rewrite_replicas(command, self.replicas_after_crash)
 
 
 @dataclass
@@ -325,8 +378,11 @@ class FleetSupervisor:
 
     def _spawn_command(self, worker: SupervisedWorker) -> List[str]:
         """The command one spawn runs — a seam `chaos_procfleet` wraps
-        to inject boot flakes."""
-        return list(worker.spec.command)
+        to inject boot flakes, and the policy's `respawn_command` hook
+        rewrites (e.g. a new `-replicas` count for an elastic
+        resurrection on a shrunken host)."""
+        return self.policy.respawn_command(worker,
+                                           list(worker.spec.command))
 
     def _count_spawn_retry(self) -> None:
         with self._lock:

@@ -61,6 +61,12 @@ def pytest_configure(config):
         "markers", "lint: dl4jlint static-analysis gates — per-pass "
         "fixtures, baseline workflow, the zero-new-findings sweep over "
         "the real tree (pure AST, no jax; fast, run in tier-1)")
+    config.addinivalue_line(
+        "markers", "elastic: elastic checkpoint plane — sharded "
+        "snapshots with SHA-256 integrity, two-phase atomic commit "
+        "(kill -9 at every boundary), N→M topology-elastic restore, "
+        "corruption fallback, crash-safe resume incl. a real training "
+        "process killed mid-save (fast; run in tier-1)")
 
 
 @pytest.fixture

@@ -150,6 +150,69 @@ class TestRestartPolicy:
         with pytest.raises(ValueError, match="crash_loop_threshold"):
             RestartPolicy(crash_loop_threshold=0)
 
+    def test_rewrite_replicas_forms(self):
+        from deeplearning4j_tpu.serving.procfleet import rewrite_replicas
+
+        assert rewrite_replicas(["w", "-replicas", "4"], 2) == \
+            ["w", "-replicas", "2"]
+        assert rewrite_replicas(["w", "--replicas=4"], 2) == \
+            ["w", "--replicas=2"]
+        # absent flag: appended
+        assert rewrite_replicas(["w"], 2) == ["w", "--replicas", "2"]
+
+
+class TestElasticRestart:
+    def test_respawn_passes_shrunken_replicas(self):
+        """The elastic-restart hook (ISSUE-12 satellite): a worker
+        launched with `-replicas 4` crashes; `ElasticRestartPolicy`
+        rewrites the respawn command to `-replicas 2`, and the
+        resurrected REAL process reports the shrunken count — the
+        training-side twin of the checkpoint plane's N→M restore (the
+        snapshot the worker resumes from restores onto any count)."""
+        from deeplearning4j_tpu.serving.procfleet import (
+            ElasticRestartPolicy,
+        )
+
+        router = FleetRouter()
+        policy = ElasticRestartPolicy(
+            replicas_after_crash=2, backoff_initial_s=0.05,
+            backoff_max_s=0.5, jitter=0.0)
+        sup = _fast_supervisor(router, policy=policy)
+        try:
+            port = _free_port()
+            url = f"http://127.0.0.1:{port}"
+            worker = sup.manage(WorkerSpec(
+                name="elastic", url=url,
+                command=stub_worker_command(port) + ["--replicas", "4"]))
+            assert sup.wait_all_ready(15.0)
+
+            def stats():
+                import json as _json
+
+                with urllib.request.urlopen(url + "/serving/stats",
+                                            timeout=5) as r:
+                    return _json.loads(r.read())
+
+            assert stats()["replicas"] == 4      # as configured
+            os.kill(worker.proc.pid, signal.SIGKILL)
+            _drive_until(
+                sup,
+                lambda s: (s.poll_once()["elastic"] == WORKER_READY
+                           and s.counters["restarts"] >= 1),
+                what="elastic backoff restart")
+            assert stats()["replicas"] == 2      # resurrection shrank
+        finally:
+            sup.stop(grace_s=5.0)
+            router.stop()
+
+    def test_elastic_policy_validates(self):
+        from deeplearning4j_tpu.serving.procfleet import (
+            ElasticRestartPolicy,
+        )
+
+        with pytest.raises(ValueError, match="replicas_after_crash"):
+            ElasticRestartPolicy(replicas_after_crash=0)
+
 
 # ---------------------------------------------------------------------------
 # Launcher hygiene: logs, reaping, process groups, port collisions

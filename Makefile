@@ -4,7 +4,8 @@
 .PHONY: test test-serving test-precision test-fleet test-paged \
 	test-procfleet dryrun bench smoke serving-smoke bench-precision \
 	bench-fleet bench-paged bench-procfleet test-obs bench-obs \
-	obs-smoke evidence lint test-lint test-elastic bench-elastic
+	obs-smoke evidence lint test-lint test-elastic bench-elastic \
+	test-spec bench-spec
 
 # lint first: the four-pass static sweep is ~1s and fails fast on a
 # race/host-sync/recompile-hazard/broad-except finding before the
@@ -48,6 +49,21 @@ test-paged:
 # (docs/performance.md "The KV memory cost model").
 bench-paged:
 	BENCH_ONLY=paged python bench.py
+
+# Speculative-decode tests only (drafter plane: n-gram property suite +
+# small-model drafter, wide verify with in-jit accept/rollback, greedy
+# byte-parity vs generate() incl. adversarial drafters, rollback page
+# hygiene, unsupported-combo admission, zero-recompile guard).
+test-spec:
+	python -m pytest tests/ -q -m spec
+
+# Speculative-decode bench row: shared-prefix greedy storm, n-gram
+# drafter vs the PR-7 paged baseline — gates tokens_per_dispatch > 1.5,
+# a tokens/s win, byte-parity sentinel, balanced page ledger, zero
+# off-ladder compiles (docs/performance.md "The speculative decode
+# cost model").
+bench-spec:
+	BENCH_ONLY=speculative python bench.py
 
 # Observability-plane tests only (metrics registry + exposition,
 # request tracing across the fleet, compile watcher, training
@@ -101,7 +117,7 @@ smoke:
 # + the overload/admission-control row + the fleet mid-storm-kill row +
 # the paged-KV shared-prefix row).
 serving-smoke:
-	BENCH_ONLY=serving,servinglm,servingoverload,servingfleet,paged python bench.py
+	BENCH_ONLY=serving,servinglm,servingoverload,servingfleet,paged,speculative python bench.py
 
 # Precision-plane tests only (bf16-mixed parity/determinism, loss-scaler
 # overflow recovery, int8 serving agreement, dtype round-trips).

@@ -495,6 +495,10 @@ def cmd_serve(args) -> int:
                   f"{type(first).__name__}); the first request per "
                   "bucket compiles instead")
     if args.lm:
+        if args.lm_speculate != "off" and args.lm_kv != "paged":
+            raise SystemExit(
+                "serve: -lm-speculate requires -lm-kv paged "
+                "(speculative rollback rides the page tables)")
         cfg, params = _load_saved_lm(pathlib.Path(args.lm))
         srv.serve_lm(cfg, params, slots=args.lm_slots,
                      max_queue_depth=max_queue,
@@ -502,7 +506,9 @@ def cmd_serve(args) -> int:
                      breaker_threshold=breaker_n,
                      kv=args.lm_kv, page_size=args.page_size,
                      pages=(args.lm_pages if args.lm_pages > 0 else None),
-                     prefill_chunk=args.prefill_chunk)
+                     prefill_chunk=args.prefill_chunk,
+                     speculate=args.lm_speculate,
+                     draft_len=args.draft_len)
         lm_srv = srv.state.lm_server
         # -warmup opts the LM pool into pre-traffic compiles too, same
         # contract as the classifier path: without it each program
@@ -512,11 +518,14 @@ def cmd_serve(args) -> int:
         warm_note = (f"{warmed} programs warm" if warmed
                      else "programs compile on first use")
         if lm_srv is not None and args.lm_kv == "paged":
+            spec_note = (f", speculate {lm_srv.speculate} "
+                         f"(draft_len {lm_srv.draft_len})"
+                         if lm_srv.speculate != "off" else "")
             print(f"serve: LM registered ({cfg.n_layers}L/d{cfg.d_model}, "
                   f"max_len {cfg.max_len}, {args.lm_slots} decode slots, "
                   f"paged KV: {lm_srv.kv_pages} pages x "
                   f"{lm_srv.page_size} tokens, prefill chunk "
-                  f"{lm_srv.prefill_chunk}, {warm_note})")
+                  f"{lm_srv.prefill_chunk}{spec_note}, {warm_note})")
         else:
             print(f"serve: LM registered ({cfg.n_layers}L/d{cfg.d_model}, "
                   f"max_len {cfg.max_len}, {args.lm_slots} decode slots, "
@@ -1281,6 +1290,23 @@ def build_parser() -> argparse.ArgumentParser:
                          type=int, default=16,
                          help="tokens per KV page (prefix sharing is "
                               "page-granular)")
+    p_serve.add_argument("-lm-speculate", "--lm-speculate",
+                         dest="lm_speculate",
+                         choices=["off", "ngram", "model"],
+                         default="off",
+                         help="speculative multi-token decode for "
+                              "greedy LM lanes (paged KV only): a "
+                              "cheap drafter proposes draft-len "
+                              "tokens per round, the target verifies "
+                              "the chunk in ONE wide dispatch with "
+                              "in-jit accept/rollback; 'ngram' = free "
+                              "host-side prompt-lookup, 'model' = "
+                              "self-drafting small-model plane "
+                              "(docs/performance.md)")
+    p_serve.add_argument("-draft-len", "--draft-len", dest="draft_len",
+                         type=int, default=4,
+                         help="max draft tokens proposed per lane per "
+                              "round under -lm-speculate (default 4)")
     p_serve.add_argument("-prefill-chunk", "--prefill-chunk",
                          dest="prefill_chunk", type=int, default=8,
                          help="max prompt tokens fed per dispatch "

@@ -378,16 +378,19 @@ def _paged_attn(p, x, layer_k, layer_v, table, pos, n_feed):
             fv.reshape(pages, ps, h, kd))
 
 
-def paged_decode_step(cfg: TransformerConfig, params: dict, cache: dict,
-                      table: jax.Array, pos: jax.Array, n_feed: jax.Array,
-                      tokens: jax.Array) -> Tuple[jax.Array, dict]:
+def paged_forward(cfg: TransformerConfig, params: dict, cache: dict,
+                  table: jax.Array, pos: jax.Array, n_feed: jax.Array,
+                  tokens: jax.Array) -> Tuple[jax.Array, dict]:
     """tokens: [B, C] int32, lane b feeding its first n_feed[b] columns
-    at positions pos[b].. -> (logits [B, V] at each lane's LAST fed
-    column, cache with the fed k/v scattered into the page pool).
+    at positions pos[b].. -> (logits [B, C, V] at EVERY fed column,
+    cache with the fed k/v scattered into the page pool).
 
     Identical math to `slot_decode_step` per position — the chunk's own
     writes land in the pool before the gather, so intra-chunk causal
-    attention rides the same masked-softmax path as the history."""
+    attention rides the same masked-softmax path as the history.  The
+    all-column logits are what the speculative verify step consumes
+    (`make_spec_step`): column j scores the token that should FOLLOW
+    fed token j."""
     c = tokens.shape[1]
     wpos = pos[:, None] + jnp.arange(c)[None, :]
     pidx = jnp.minimum(wpos, cfg.max_len - 1)             # clip padding
@@ -406,9 +409,19 @@ def paged_decode_step(cfg: TransformerConfig, params: dict, cache: dict,
                  if "moe" in layer else _mlp(layer["mlp"], hh))
     x = _layer_norm(params["ln_f"], x)
     logits = jnp.einsum("bcd,dv->bcv", x, lm_head(params))
+    return logits, {"k": jnp.stack(ks), "v": jnp.stack(vs)}
+
+
+def paged_decode_step(cfg: TransformerConfig, params: dict, cache: dict,
+                      table: jax.Array, pos: jax.Array, n_feed: jax.Array,
+                      tokens: jax.Array) -> Tuple[jax.Array, dict]:
+    """`paged_forward` with logits taken at each lane's LAST fed column
+    (-> [B, V]) — the chunked-prefill/decode entry point."""
+    logits, cache = paged_forward(cfg, params, cache, table, pos, n_feed,
+                                  tokens)
     last = jnp.take_along_axis(
         logits, jnp.maximum(n_feed - 1, 0)[:, None, None], axis=1)[:, 0]
-    return last, {"k": jnp.stack(ks), "v": jnp.stack(vs)}
+    return last, cache
 
 
 @functools.lru_cache(maxsize=16)
@@ -445,6 +458,105 @@ def make_paged_step(cfg: TransformerConfig, pages: int, page_size: int,
     temperature [B], seeds [B], counts [B]) -> (next_token [B], k, v)."""
     return _compiled_paged_step(cfg, int(pages), int(page_size),
                                 int(chunk))
+
+
+# ---------------------------------------------------------------------------
+# Speculative verify (multi-token decode on the chunked-feed path)
+#
+# `paged_decode_step` already scores a [B, C] token chunk per lane in
+# ONE wide dispatch — built for chunked prefill, where every fed token
+# is ground truth.  Speculative decoding generalizes the same program
+# shape to DECODE: a cheap drafter (serving/draft.py) proposes up to
+# `draft_len` tokens per lane, the target model scores
+# [last_committed, d_1..d_k] in one wide dispatch, and the accept rule
+# runs IN-JIT — the longest draft prefix where the target's greedy
+# argmax agrees, plus the target's own next token at the divergence
+# point (the "bonus" token).  Greedy output is byte-identical to
+# 1-token decode by construction: emitted token i is always
+# argmax(target | committed history), whether it arrived as an accepted
+# draft or as the bonus.  Rollback is free on the paged pool: rejected
+# columns wrote k/v into the lane's OWN future pages (or the null
+# page), positions the causal mask already hides — the host just
+# advances `pos` by 1 + accepted instead of by n_feed, a pointer move,
+# never a copy.  The step returns per-lane accepted counts so the host
+# syncs ONCE per round, not per token.
+
+
+def spec_verify_step(cfg: TransformerConfig, params: dict, cache: dict,
+                     table: jax.Array, pos: jax.Array, n_feed: jax.Array,
+                     n_draft: jax.Array, tokens: jax.Array
+                     ) -> Tuple[jax.Array, jax.Array, dict]:
+    """tokens: [B, W] int32; lane b feeds its first n_feed[b] columns.
+    Two lane shapes are supported, and the accept mask assumes them:
+    a VERIFY lane feeds exactly one committed token followed by its
+    drafts — [last_committed, d_1..d_k] with n_feed = k+1 and
+    n_draft = k — and a TEACHER-FORCED lane (prefill chunk, plain
+    decode, or padding) feeds any n_feed with n_draft = 0.  Shapes
+    with more than one committed token ahead of drafts
+    (n_feed > n_draft + 1 with n_draft > 0) are NOT supported: the
+    draft window is hardwired to columns 1..n_draft.
+
+    -> (bonus_logits [B, V] at each lane's divergence column,
+        accepted [B] int32 draft tokens accepted, cache).
+
+    Draft d_i is accepted iff every earlier draft was AND the target's
+    greedy argmax after consuming through column i-1 equals d_i; the
+    bonus logits are the target's distribution at the column AFTER the
+    last accepted token — exactly the logits 1-token decode would have
+    produced there, so greedy parity is byte-exact and a sampled lane
+    (n_draft = 0) sees precisely its last-fed column."""
+    logits, cache = paged_forward(cfg, params, cache, table, pos, n_feed,
+                                  tokens)
+    logits = logits.astype(jnp.float32)                    # [B, W, V]
+    pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B, W]
+    w = tokens.shape[1]
+    # column j in [1, W): draft position j is live iff j <= n_draft
+    live = jnp.arange(1, w)[None, :] <= n_draft[:, None]   # [B, W-1]
+    ok = (pred[:, :-1] == tokens[:, 1:]) & live
+    # length of the initial all-True run = accepted draft count
+    accepted = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+    # divergence column: the last committed feed column (n_feed-1-n_draft)
+    # advanced by the accepted run; == n_feed-1 when n_draft == 0
+    bonus_col = jnp.clip(n_feed - 1 - n_draft + accepted, 0, w - 1)
+    blog = jnp.take_along_axis(
+        logits, bonus_col[:, None, None], axis=1)[:, 0]    # [B, V]
+    return blog, accepted.astype(jnp.int32), cache
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_spec_step(cfg: TransformerConfig, pages: int,
+                        page_size: int, width: int):
+    """One jitted speculative-verify program per (config, pages,
+    page_size, width): forward + in-jit accept/rollback + the SAME
+    per-slot sampling automaton as `_compiled_paged_step` applied at
+    the bonus column, so a sampled lane riding this wide dispatch with
+    n_draft = 0 samples byte-identically to the 1-wide program."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def step(params, cache_k, cache_v, table, pos, n_feed, n_draft,
+             tokens, temperature, seeds, counts):
+        cache = {"k": cache_k, "v": cache_v}
+        blog, accepted, cache = spec_verify_step(
+            cfg, params, cache, table, pos, n_feed, n_draft, tokens)
+        greedy = jnp.argmax(blog, axis=-1)
+        keys = jax.vmap(lambda s, c: jax.random.fold_in(
+            jax.random.PRNGKey(s), c))(seeds, counts)
+        temp = jnp.maximum(temperature, 1e-6)[:, None]
+        sampled = jax.vmap(jax.random.categorical)(keys, blog / temp)
+        nxt = jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+        return nxt, accepted, cache["k"], cache["v"]
+
+    return step
+
+
+def make_spec_step(cfg: TransformerConfig, pages: int, page_size: int,
+                   width: int):
+    """Compiled speculative-verify entry for the LM pool:
+    fn(params, k, v, table [B, MP], pos [B], n_feed [B], n_draft [B],
+    tokens [B, W], temperature [B], seeds [B], counts [B])
+    -> (bonus_token [B], accepted [B], k, v)."""
+    return _compiled_spec_step(cfg, int(pages), int(page_size),
+                               int(width))
 
 
 @functools.lru_cache(maxsize=16)

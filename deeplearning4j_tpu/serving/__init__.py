@@ -19,7 +19,15 @@ coalescing K concurrent *requests* per device dispatch.
   on completion (`PagePool`), shared prompt prefixes prefilled once and
   radix-cached (`RadixPrefixCache`, copy-on-write at the divergence
   page), long prompts fed up to `prefill_chunk` tokens per dispatch;
-  `kv="dense"` keeps the original `[slots, max_len]` cache;
+  `kv="dense"` keeps the original `[slots, max_len]` cache; with
+  `speculate="ngram"`/`"model"` (ISSUE-13) a cheap drafter
+  (`draft.py`: prompt-lookup `NgramDrafter`, small-model
+  `ModelDrafter`) proposes up to `draft_len` tokens per greedy lane
+  per round and the target verifies the whole chunk in ONE wide
+  dispatch with in-jit accept/rollback — ~2-4 committed tokens per
+  dispatch at byte-identical greedy output, rollback a block-table
+  pointer move (docs/performance.md "The speculative decode cost
+  model");
 - `ServingMetrics` — queue depth, batch occupancy, p50/p95/p99 latency,
   requests/s and tokens/s, plus the resilience ledger (`rejected`,
   `shed`, `deadline_missed`, `poison_isolated`, `breaker_state`)
@@ -64,6 +72,11 @@ from deeplearning4j_tpu.serving.bucketing import (
     DEFAULT_BATCH_BUCKETS,
     pow2_length_buckets,
 )
+from deeplearning4j_tpu.serving.draft import (
+    Drafter,
+    ModelDrafter,
+    NgramDrafter,
+)
 from deeplearning4j_tpu.serving.engine import ServingEngine
 from deeplearning4j_tpu.serving.fleet import (
     FleetClientError,
@@ -104,11 +117,14 @@ __all__ = [
     "CrashLoopError",
     "DEFAULT_BATCH_BUCKETS",
     "DeadlineExceededError",
+    "Drafter",
     "FleetClientError",
     "FleetRouter",
     "FleetServer",
     "FleetSupervisor",
     "MicroBatcher",
+    "ModelDrafter",
+    "NgramDrafter",
     "RestartPolicy",
     "PageLeakError",
     "PagePool",

@@ -241,6 +241,8 @@ def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
                         lm_kv: str = "paged", lm_page_size: int = 16,
                         lm_pages: Optional[int] = None,
                         lm_prefill_chunk: int = 8,
+                        lm_speculate: str = "off",
+                        lm_draft_len: int = 4,
                         version: int = 0) -> Replica:
     """Thread-hosted replica: an in-process `UiServer` on a free port
     with its own engine surface (`/model/predict`, `/lm/generate`,
@@ -272,7 +274,8 @@ def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
                      breaker_threshold=breaker_threshold,
                      breaker_cooldown_s=breaker_cooldown_s,
                      kv=lm_kv, page_size=lm_page_size, pages=lm_pages,
-                     prefill_chunk=lm_prefill_chunk)
+                     prefill_chunk=lm_prefill_chunk,
+                     speculate=lm_speculate, draft_len=lm_draft_len)
         # warm the paged programs BEFORE the replica enters rotation —
         # same zero-compile-on-the-request-path rule as warmup_example
         if srv.state.lm_server is not None:
@@ -919,6 +922,20 @@ class FleetRouter:
             prefix["hit_rate"] = round(
                 prefix["hits"] / prefix["queries"], 3)
             fleet["lm_prefix"] = prefix
+        # fleet-level speculative-decode view (ISSUE-13): drafted vs
+        # accepted across every replica's LM pool — the fleet-wide
+        # accept rate is what says speculation is paying for itself
+        spec = {"drafted": 0, "accepted": 0, "rounds": 0}
+        for payload in stats_by_name.values():
+            lm = (payload or {}).get("lm") or {}
+            if lm.get("spec_drafted"):
+                spec["drafted"] += int(lm["spec_drafted"])
+                spec["accepted"] += int(lm.get("spec_accepted") or 0)
+                spec["rounds"] += int(lm.get("spec_rounds") or 0)
+        if spec["drafted"]:
+            spec["accept_rate"] = round(
+                spec["accepted"] / spec["drafted"], 3)
+            fleet["lm_speculate"] = spec
         out = {"fleet": fleet, "replicas": entries, "retired": retired}
         supervisor = self.supervisor
         if supervisor is not None:

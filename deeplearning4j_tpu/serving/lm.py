@@ -48,6 +48,29 @@ top-k/top-p/beam requests take the legacy whole-sequence path in
 `ui/server.py` — their filters are static program variants, not per-slot
 switches.
 
+**Speculative multi-token decode** (ISSUE-13, `speculate="ngram"` or
+`"model"`, paged KV only): a cheap drafter (`serving/draft.py`)
+proposes up to `draft_len` continuation tokens per greedy decode lane
+per round; the target model scores `[last_committed, d_1..d_k]` in ONE
+wide dispatch through the SAME chunked-feed program ladder chunked
+prefill rides, and the accept rule runs in-jit
+(`parallel.generation.make_spec_step`): the longest draft prefix the
+target's argmax agrees with is committed, plus the target's own bonus
+token at the divergence point.  Greedy output is byte-identical to
+1-token decode by construction.  Rollback is a pointer move on the
+paged pool — rejected columns wrote k/v into the lane's own future
+pages (or the null page), positions the causal mask hides, so the host
+just advances `pos` by 1 + accepted; pages were allocated at admission
+for the whole request and flow back through the normal `PagePool`
+refcount discipline at completion, never per round.  SAMPLING lanes
+(temperature > 0) are never drafted for — verifying a sampled draft
+greedily would mis-sample — and fall back to 1-token decode per round
+while riding the same dispatches; `speculate` with `kv="dense"` is a
+typed ValueError at construction (the rollback story needs pages).
+Accounting: accept-rate / tokens-per-round counters in
+`ServingMetrics`, a `speculate` section in `stats()`, and
+drafted/accepted attrs on each request's decode trace span.
+
 Resilience contract (ISSUE-4, mirrors `batcher.MicroBatcher`): bounded
 admission (`max_queue_depth` -> `ServingOverloadError`), per-request
 deadlines shed at the admitter before a prompt ever occupies a slot
@@ -119,7 +142,8 @@ def validate_request(cfg, prompt_ids, max_new_tokens: int) -> List[int]:
 class _LMRequest:
     __slots__ = ("prompt", "max_new", "temperature", "seed", "event",
                  "result", "error", "enqueued", "deadline", "abandoned",
-                 "request_id", "t_installed", "t_done", "prefix_matched")
+                 "request_id", "t_installed", "t_done", "prefix_matched",
+                 "drafted", "accepted")
 
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
                  seed: int, deadline: Optional[float] = None,
@@ -138,6 +162,8 @@ class _LMRequest:
         self.t_installed: Optional[float] = None  # slot-install stamp
         self.t_done: Optional[float] = None       # decode-complete stamp
         self.prefix_matched = 0            # radix-cache tokens reused
+        self.drafted = 0                   # speculative tokens proposed
+        self.accepted = 0                  # speculative tokens accepted
 
 
 class _Slot:
@@ -178,6 +204,8 @@ class ContinuousLMServer:
                  breaker: Optional[CircuitBreaker] = None,
                  kv: str = "paged", page_size: int = 16,
                  pages: Optional[int] = None, prefill_chunk: int = 8,
+                 speculate: str = "off", draft_len: int = 4,
+                 drafter=None, draft_model=None,
                  tracer: Optional[TraceRecorder] = None,
                  registry: Optional[MetricsRegistry] = None):
         if slots < 1:
@@ -192,6 +220,21 @@ class ContinuousLMServer:
         if prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if speculate not in ("off", "ngram", "model"):
+            raise ValueError(f"speculate must be 'off', 'ngram' or "
+                             f"'model', got {speculate!r}")
+        if drafter is not None and speculate == "off":
+            speculate = "custom"           # injected Drafter instance
+        if speculate != "off" and kv != "paged":
+            # typed at ADMISSION of the config, not a crash at dispatch:
+            # speculative rollback is a pointer move ONLY on the paged
+            # pool (docs/performance.md "The speculative decode cost
+            # model"); the dense cache has no cheap rewind story
+            raise ValueError(
+                f"speculate={speculate!r} requires kv='paged' "
+                f"(got kv={kv!r}): rollback rides the page tables")
+        if speculate != "off" and draft_len < 1:
+            raise ValueError(f"draft_len must be >= 1, got {draft_len}")
         self.cfg = cfg
         self.params = params
         self.n_slots = int(slots)
@@ -212,6 +255,16 @@ class ContinuousLMServer:
         if self.kv_pages < 1:
             raise ValueError(f"pages must be >= 1, got {self.kv_pages}")
         self.prefill_chunk = int(prefill_chunk)
+        self.speculate = speculate
+        self.draft_len = int(draft_len)
+        self._drafter = drafter            # built in _start_locked if None
+        self._draft_model = draft_model    # optional (cfg, params) pair
+        # the ONE wide program width: chunked prefill and speculative
+        # verify share it ([last, d_1..d_k] needs draft_len+1 columns)
+        if speculate != "off":
+            self.spec_width = max(self.prefill_chunk, self.draft_len + 1)
+        else:
+            self.spec_width = 0
         self.metrics = metrics if metrics is not None else ServingMetrics()
         # observability plane (ISSUE-8): publish the LM pool's cells on
         # the server registry, trace every request, and install the
@@ -361,7 +414,9 @@ class ContinuousLMServer:
                 prompt_tokens=len(req.prompt),
                 generated=(len(req.result) - len(req.prompt)
                            if req.result else 0),
-                prefix_matched=req.prefix_matched or None))
+                prefix_matched=req.prefix_matched or None,
+                drafted=req.drafted or None,
+                accepted=(req.accepted if req.drafted else None)))
             if self._compile_watch.any_since(req.t_installed):
                 for c_end, c_dur, key in (self._compile_watch
                                           .events_between(req.t_installed,
@@ -420,14 +475,25 @@ class ContinuousLMServer:
             self._cache = (k, v)
             return
         table = np.zeros((self.n_slots, self.max_pages), np.int32)
-        widths = [1] + ([self.prefill_chunk]
-                        if self.prefill_chunk > 1 else [])
-        for w in widths:
-            tok = np.zeros((self.n_slots, w), np.int32)
-            with compile_scope(f"lm:paged[w{w}]"):
-                _, k, v = self._step(self.params, *self._cache, table,
-                                     zi, zi, tok, zf, zi, zi)
-            self._cache = (k, v)
+        if self.speculate != "off":
+            widths = [1, self.spec_width]
+            for w in widths:
+                tok = np.zeros((self.n_slots, w), np.int32)
+                with compile_scope(f"lm:paged[w{w}]"):
+                    out = self._step(self.params, *self._cache, table,
+                                     zi, zi, zi, tok, zf, zi, zi)
+                self._cache = (out[-2], out[-1])
+            if hasattr(self._drafter, "warmup"):
+                self._drafter.warmup()
+        else:
+            widths = [1] + ([self.prefill_chunk]
+                            if self.prefill_chunk > 1 else [])
+            for w in widths:
+                tok = np.zeros((self.n_slots, w), np.int32)
+                with compile_scope(f"lm:paged[w{w}]"):
+                    _, k, v = self._step(self.params, *self._cache,
+                                         table, zi, zi, tok, zf, zi, zi)
+                self._cache = (k, v)
         with compile_scope("lm:page_copy"):
             k, v = self._copy(*self._cache, np.int32(0), np.int32(0))
         self._cache = (k, v)
@@ -435,6 +501,14 @@ class ContinuousLMServer:
     def compiled_programs(self) -> int:
         if self.kv == "dense":
             return 1
+        if self.speculate != "off":
+            # 1-wide decode + the shared prefill/verify wide program +
+            # page copy, plus whatever the drafter runs on device
+            drafter = (self._drafter.compiled_programs()
+                       if self._drafter is not None
+                       and hasattr(self._drafter, "compiled_programs")
+                       else 0)
+            return 3 + drafter
         return 2 + (1 if self.prefill_chunk > 1 else 0)
 
     def stop(self) -> None:
@@ -538,6 +612,20 @@ class ContinuousLMServer:
                     "radix_nodes": (self._tree.nodes
                                     if self._tree is not None else 0)})
             out["kv"] = kv
+            if self.speculate != "off":
+                spec = {"mode": self.speculate,
+                        "draft_len": self.draft_len,
+                        "verify_width": self.spec_width}
+                drafted = out.get("spec_drafted", 0)
+                if drafted:
+                    spec.update({
+                        "drafted": drafted,
+                        "accepted": out.get("spec_accepted", 0),
+                        "accept_rate": out.get("spec_accept_rate", 0.0)})
+                if out.get("decode_rounds"):
+                    spec["tokens_per_decode_round"] = out.get(
+                        "tokens_per_decode_round", 0.0)
+                out["speculate"] = spec
         out["max_len"] = self.cfg.max_len
         out["compiled_programs"] = self.compiled_programs()
         # first-class compile accounting (ISSUE-8): XLA compiles the
@@ -587,6 +675,11 @@ class ContinuousLMServer:
             s.owned = []
             s.shared = []
             s.inserted = False
+        if self._drafter is not None:
+            # the drafter's lane state tracked lanes that no longer
+            # exist; its own cache self-heals via the common-prefix
+            # rewind, but the bookkeeping must not outlive the pool
+            self._drafter.reset()
         self.metrics.set_pages(0, self.kv_pages, self.kv_pages)
 
     def _start_locked(self) -> None:
@@ -601,26 +694,61 @@ class ContinuousLMServer:
                 from deeplearning4j_tpu.parallel.generation import (
                     make_page_copy,
                     make_paged_step,
+                    make_spec_step,
                 )
 
                 total = self.kv_pages + 1
                 self._decode_step = make_paged_step(
                     self.cfg, total, self.page_size, 1)
-                self._chunk_step = (make_paged_step(
-                    self.cfg, total, self.page_size, self.prefill_chunk)
-                    if self.prefill_chunk > 1 else None)
+                if self.speculate != "off":
+                    # ONE wide program serves chunked prefill AND the
+                    # speculative verify — the same chunked-feed ladder,
+                    # widened to fit [last, d_1..d_draft_len]
+                    self._chunk_step = make_spec_step(
+                        self.cfg, total, self.page_size, self.spec_width)
+                else:
+                    self._chunk_step = (make_paged_step(
+                        self.cfg, total, self.page_size,
+                        self.prefill_chunk)
+                        if self.prefill_chunk > 1 else None)
                 self._copy = make_page_copy(self.cfg, total,
                                             self.page_size)
+                if self.speculate != "off" and self._drafter is None:
+                    from deeplearning4j_tpu.serving.draft import (
+                        make_drafter,
+                    )
 
-                def dispatch(params, k, v, table, pos, n_feed, tokens,
-                             temperature, seeds, counts):
-                    # ONE entry point for every paged dispatch (decode
-                    # and prefill-chunk widths) so fault-injection tests
-                    # that stub `self._step` intercept them all
-                    fn = (self._decode_step if tokens.shape[1] == 1
-                          else self._chunk_step)
-                    return fn(params, k, v, table, pos, n_feed, tokens,
-                              temperature, seeds, counts)
+                    self._drafter = make_drafter(
+                        self.speculate, self.cfg, self.params,
+                        self.n_slots, draft_model=self._draft_model)
+
+                if self.speculate != "off":
+                    def dispatch(params, k, v, table, pos, n_feed,
+                                 n_draft, tokens, temperature, seeds,
+                                 counts):
+                        # speculative signature: every dispatch carries
+                        # n_draft and returns per-lane accepted counts
+                        # (zeros on the 1-wide plain-decode program)
+                        if tokens.shape[1] == 1:
+                            nxt, k, v = self._decode_step(
+                                params, k, v, table, pos, n_feed,
+                                tokens, temperature, seeds, counts)
+                            return nxt, np.zeros(
+                                (self.n_slots,), np.int32), k, v
+                        return self._chunk_step(
+                            params, k, v, table, pos, n_feed, n_draft,
+                            tokens, temperature, seeds, counts)
+                else:
+                    def dispatch(params, k, v, table, pos, n_feed,
+                                 tokens, temperature, seeds, counts):
+                        # ONE entry point for every paged dispatch
+                        # (decode and prefill-chunk widths) so
+                        # fault-injection tests that stub `self._step`
+                        # intercept them all
+                        fn = (self._decode_step if tokens.shape[1] == 1
+                              else self._chunk_step)
+                        return fn(params, k, v, table, pos, n_feed,
+                                  tokens, temperature, seeds, counts)
 
                 self._step = dispatch
             self._reset_pool_locked()
@@ -902,6 +1030,43 @@ class ContinuousLMServer:
             self.metrics.record_tokens(emitted)
         return True
 
+    def _draft_proposals(self) -> Dict[int, List[int]]:
+        """One drafting round: collect per-lane proposals for GREEDY
+        decode-phase lanes with budget left.  Sampling lanes
+        (temperature > 0) are never drafted for — a greedy accept rule
+        over a sampled lane would mis-sample — and ride the round as
+        plain 1-token decode; so do lanes mid-prefill and lanes within
+        one token of their budget.  Out-of-vocab draft tokens (a
+        misbehaving custom Drafter) are truncated at the first offender
+        so the verify feed stays a valid token chunk."""
+        histories: List[Optional[List[int]]] = [None] * self.n_slots
+        budgets = [0] * self.n_slots
+        for i, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            req = slot.req
+            remaining = req.max_new - len(slot.generated)
+            if (slot.fed >= len(req.prompt) and req.temperature == 0
+                    and remaining >= 2 and slot.generated):
+                histories[i] = req.prompt + slot.generated
+                budgets[i] = min(self.draft_len, remaining - 1)
+        if not any(budgets):
+            return {}
+        proposals = self._drafter.propose(histories, budgets)
+        out: Dict[int, List[int]] = {}
+        for i, prop in enumerate(proposals):
+            if not budgets[i] or not prop:
+                continue
+            clean: List[int] = []
+            for t in prop[:budgets[i]]:
+                t = int(t)
+                if not 0 <= t < self.cfg.vocab_size:
+                    break
+                clean.append(t)
+            if clean:
+                out[i] = clean
+        return out
+
     def _dispatch_paged(self, active, cow) -> bool:
         # land pending copy-on-write pages first: the divergence page's
         # matched prefix must be resident before its lane's first feed
@@ -911,21 +1076,28 @@ class ContinuousLMServer:
                                   np.int32(item["dst"]))
             self._cache = (k, v)
             self._pool.release([item["src"]])
+        drafts = (self._draft_proposals()
+                  if self._drafter is not None else {})
         # chunk width: the wide program dispatches only while some lane
         # has a FULL chunk of prompt left to feed — sub-chunk tails and
-        # pure-decode rounds ride the 1-wide program.  Short-prompt
-        # traffic therefore never compiles (or pays for) the wide
-        # program at all; a long prompt costs ceil(P/chunk) wide
-        # dispatches plus its tail.
+        # pure-decode rounds ride the 1-wide program — or, with
+        # speculation on, while some lane has drafts to verify (and
+        # then prompt tails hitch a ride on the already-paid wide
+        # dispatch).  Short-prompt non-speculative traffic therefore
+        # never compiles (or pays for) the wide program at all; a long
+        # prompt costs ceil(P/chunk) wide dispatches plus its tail.
         width = 1
-        if self._chunk_step is not None:
-            for s in active:
-                if len(s.req.prompt) - s.fed >= self.prefill_chunk:
-                    width = self.prefill_chunk
-                    break
+        full_chunk = any(len(s.req.prompt) - s.fed >= self.prefill_chunk
+                         for s in active)
+        if self.speculate != "off":
+            if drafts or (full_chunk and self.prefill_chunk > 1):
+                width = self.spec_width
+        elif self._chunk_step is not None and full_chunk:
+            width = self.prefill_chunk
         tokens = np.zeros((self.n_slots, width), np.int32)
         pos = np.zeros((self.n_slots,), np.int32)
         n_feed = np.zeros((self.n_slots,), np.int32)
+        n_draft = np.zeros((self.n_slots,), np.int32)
         temp = np.zeros((self.n_slots,), np.float32)
         seeds = np.zeros((self.n_slots,), np.int32)
         counts = np.zeros((self.n_slots,), np.int32)
@@ -936,9 +1108,15 @@ class ContinuousLMServer:
             req = slot.req
             remaining = len(req.prompt) - slot.fed
             if remaining > 0:                  # chunked prefill
-                f = min(remaining, width)
+                f = min(remaining, width, self.prefill_chunk)
                 tokens[i, :f] = req.prompt[slot.fed:slot.fed + f]
                 n_feed[i] = f
+            elif width > 1 and i in drafts:    # speculative verify
+                prop = drafts[i]
+                tokens[i, 0] = slot.generated[-1]
+                tokens[i, 1:1 + len(prop)] = prop
+                n_feed[i] = 1 + len(prop)
+                n_draft[i] = len(prop)
             else:                              # decode: feed last sample
                 tokens[i, 0] = slot.generated[-1]
                 n_feed[i] = 1
@@ -948,27 +1126,57 @@ class ContinuousLMServer:
             counts[i] = len(slot.generated)
             table[i] = slot.table
         with compile_scope(f"lm:paged[w{width}]"):
-            nxt, k, v = self._step(self.params, *self._cache, table, pos,
-                                   n_feed, tokens, temp, seeds, counts)
+            if self.speculate != "off":
+                nxt, acc, k, v = self._step(
+                    self.params, *self._cache, table, pos, n_feed,
+                    n_draft, tokens, temp, seeds, counts)
+            else:
+                nxt, k, v = self._step(self.params, *self._cache, table,
+                                       pos, n_feed, tokens, temp, seeds,
+                                       counts)
+                acc = None
         if self.breaker is not None:
             self.breaker.record_success()
         self._cache = (k, v)
+        # ONE host sync per round: the bonus tokens and the per-lane
+        # accepted counts arrive together, never per token
         nxt = np.asarray(nxt)
+        acc = np.asarray(acc) if acc is not None else None
         self._steps += 1
         emitted = 0
         for i, slot in enumerate(self._slots):
             if not slot.active or n_feed[i] == 0:
                 continue
-            slot.pos += int(n_feed[i])
             if slot.fed < len(slot.req.prompt):
+                slot.pos += int(n_feed[i])
                 slot.fed += int(n_feed[i])
                 if slot.fed < len(slot.req.prompt):
                     continue
                 # prefill complete: its full pages become reusable, and
                 # the last prompt token's logits yield the first sample
                 self._insert_prompt_pages(slot)
-            slot.generated.append(int(nxt[i]))
-            emitted += 1
+                slot.generated.append(int(nxt[i]))
+                emitted += 1
+            else:
+                # decode fold with in-jit accept/rollback: commit the
+                # accepted draft prefix plus the bonus token; rewind is
+                # a pointer move — pos advances past ONLY the committed
+                # feeds, so rejected columns' k/v (written into the
+                # lane's own future pages) stay masked until real
+                # writes land over them.  No pages move: the lane's
+                # pages were granted at admission and flow back through
+                # `_free_slot_pages` refcounts at completion.
+                a = int(acc[i]) if acc is not None else 0
+                k_drafted = int(n_draft[i])
+                slot.pos += 1 + a
+                if k_drafted:
+                    slot.generated.extend(drafts[i][:a])
+                    slot.req.drafted += k_drafted
+                    slot.req.accepted += a
+                slot.generated.append(int(nxt[i]))
+                emitted += 1 + a
+                self.metrics.record_decode_round(
+                    1 + a, drafted=k_drafted, accepted=a)
             if len(slot.generated) >= slot.req.max_new:
                 self._finish_slot(slot)
         self.metrics.record_dispatch(len(active), self.n_slots)

@@ -102,6 +102,23 @@ class ServingMetrics:
             "serving_kv_pages_free", "KV pages on the free list")
         self.pages_total_gauge = Gauge(
             "serving_kv_pages_total", "KV pool size (0 = not paged)")
+        # speculative-decode ledger (ISSUE-13): tokens-per-dispatch is
+        # bought with accepted drafts — E[tokens/round] = accept + 1
+        self.decode_rounds_total = Counter(
+            "serving_lm_decode_lane_rounds_total",
+            "decode-phase lane-dispatches (each emits >= 1 token)")
+        self.decode_tokens_total = Counter(
+            "serving_lm_decode_tokens_total",
+            "tokens emitted by decode-phase lane-dispatches")
+        self.spec_rounds_total = Counter(
+            "serving_spec_rounds_total",
+            "lane-dispatches that verified >= 1 draft token")
+        self.spec_drafted_total = Counter(
+            "serving_spec_drafted_total",
+            "draft tokens proposed to the verify step")
+        self.spec_accepted_total = Counter(
+            "serving_spec_accepted_total",
+            "draft tokens the target model accepted")
         # latency: end-to-end histogram + the queue-wait vs
         # dispatch-compute split (ISSUE-8 satellite — the batcher knows
         # both timestamps; before this they were collapsed into one
@@ -135,6 +152,9 @@ class ServingMetrics:
                   self.prefix_queries_total, self.prefix_hits_total,
                   self.prefix_tokens_saved_total, self.pages_in_use_gauge,
                   self.pages_free_gauge, self.pages_total_gauge,
+                  self.decode_rounds_total, self.decode_tokens_total,
+                  self.spec_rounds_total, self.spec_drafted_total,
+                  self.spec_accepted_total,
                   self.latency_hist, self.queue_wait_hist,
                   self.compute_hist):
             registry.register(m, **labels)
@@ -204,6 +224,19 @@ class ServingMetrics:
     def record_poison_isolated(self, n: int = 1) -> None:
         self._touch()
         self.poison_isolated_total.inc(int(n))
+
+    def record_decode_round(self, emitted: int, drafted: int = 0,
+                            accepted: int = 0) -> None:
+        """One decode-phase lane-dispatch: `emitted` tokens committed
+        (1 + accepted with speculation; always 1 without), plus the
+        round's drafted/accepted counts when a draft was verified."""
+        self._touch()
+        self.decode_rounds_total.inc()
+        self.decode_tokens_total.inc(int(emitted))
+        if drafted > 0:
+            self.spec_rounds_total.inc()
+            self.spec_drafted_total.inc(int(drafted))
+            self.spec_accepted_total.inc(int(accepted))
 
     def record_prefix_query(self, tokens_saved: int) -> None:
         """One LM admission's radix-cache outcome: `tokens_saved` prompt
@@ -276,6 +309,18 @@ class ServingMetrics:
             out["queue_wait"] = qw
         if comp["count"]:
             out["compute"] = comp
+        dec_rounds = int(self.decode_rounds_total.value)
+        if dec_rounds:
+            out["decode_rounds"] = dec_rounds
+            out["tokens_per_decode_round"] = round(
+                int(self.decode_tokens_total.value) / dec_rounds, 3)
+        drafted = int(self.spec_drafted_total.value)
+        if drafted:
+            out["spec_rounds"] = int(self.spec_rounds_total.value)
+            out["spec_drafted"] = drafted
+            out["spec_accepted"] = int(self.spec_accepted_total.value)
+            out["spec_accept_rate"] = round(
+                out["spec_accepted"] / drafted, 3)
         if pq:
             out["prefix_queries"] = pq
             out["prefix_hits"] = int(self.prefix_hits_total.value)

@@ -473,6 +473,28 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
                 raise ValueError(f"top_k must be >= 0, got {top_k}")
             if not 0.0 < top_p <= 1.0:
                 raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+            # unsupported-combo validation at ADMISSION (ISSUE-13): a
+            # client explicitly asking for speculative decode on a pool
+            # that cannot provide it (dense KV, speculation off, or no
+            # continuous pool at all) gets a typed 400 naming why, not
+            # a silently different execution plan.  Sampling lanes on a
+            # speculating pool are NOT an error: they ride the same
+            # dispatches and fall back to 1-token decode per round.
+            if bool(body.get("speculate", False)):
+                if lm_server is None:
+                    raise ValueError(
+                        "speculate requested but no continuous LM pool "
+                        "is registered (continuous=False)")
+                if lm_server.kv != "paged":
+                    raise ValueError(
+                        "speculate requested but the pool serves "
+                        "kv='dense': speculative rollback requires the "
+                        "paged KV plane (serve with -lm-kv paged)")
+                if lm_server.speculate == "off":
+                    raise ValueError(
+                        "speculate requested but the pool was started "
+                        "with speculation off (serve with -lm-speculate "
+                        "ngram|model)")
         except (ValueError, TypeError) as e:
             # bad prompt/params (incl. null/list-valued knobs) -> 400
             payload = {"error": str(e)}
@@ -549,7 +571,8 @@ class UiServer:
                  breaker_cooldown_s: float = 1.0,
                  kv: str = "paged", page_size: int = 16,
                  pages: Optional[int] = None,
-                 prefill_chunk: int = 8) -> "UiServer":
+                 prefill_chunk: int = 8, speculate: str = "off",
+                 draft_len: int = 4) -> "UiServer":
         """Register a TransformerLM for POST /lm/generate.  With
         `continuous` (default) greedy/temperature requests decode in a
         `slots`-lane continuous batching pool; `continuous=False` keeps
@@ -559,7 +582,11 @@ class UiServer:
         `page_size`, `pages` and `prefill_chunk` configure the paged KV
         pool with radix prefix reuse (docs/performance.md "The KV
         memory cost model"); `kv="dense"` keeps the original per-slot
-        dense cache."""
+        dense cache.  `speculate` ("ngram"/"model") turns on
+        speculative multi-token decode for greedy lanes with up to
+        `draft_len` drafts per round (paged KV only; sampling lanes
+        fall back to 1-token decode — docs/performance.md "The
+        speculative decode cost model")."""
         lm_server = None
         if continuous:
             from deeplearning4j_tpu.serving import (
@@ -574,7 +601,8 @@ class UiServer:
                 cfg, params, slots=slots, max_queue_depth=max_queue_depth,
                 default_deadline_s=default_deadline_s, breaker=breaker,
                 kv=kv, page_size=page_size, pages=pages,
-                prefill_chunk=prefill_chunk, tracer=self.state.tracer,
+                prefill_chunk=prefill_chunk, speculate=speculate,
+                draft_len=draft_len, tracer=self.state.tracer,
                 registry=self.state.registry)
         with self.state.lock:
             self.state.lm = (cfg, params)

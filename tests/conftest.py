@@ -62,6 +62,13 @@ def pytest_configure(config):
         "fixtures, baseline workflow, the zero-new-findings sweep over "
         "the real tree (pure AST, no jax; fast, run in tier-1)")
     config.addinivalue_line(
+        "markers", "spec: speculative-decode tests — drafter plane "
+        "(n-gram/prompt-lookup properties, small-model drafter), wide "
+        "verify with in-jit accept/rollback, greedy byte-parity vs "
+        "generate() across page sizes/chunk widths/adversarial "
+        "drafts, page-ledger hygiene under rollback-heavy storms, "
+        "unsupported-combo admission (fast; run in tier-1)")
+    config.addinivalue_line(
         "markers", "elastic: elastic checkpoint plane — sharded "
         "snapshots with SHA-256 integrity, two-phase atomic commit "
         "(kill -9 at every boundary), N→M topology-elastic restore, "

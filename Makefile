@@ -5,7 +5,7 @@
 	test-procfleet dryrun bench smoke serving-smoke bench-precision \
 	bench-fleet bench-paged bench-procfleet test-obs bench-obs \
 	obs-smoke evidence lint test-lint test-elastic bench-elastic \
-	test-spec bench-spec
+	test-spec bench-spec test-disagg bench-disagg
 
 # lint first: the four-pass static sweep is ~1s and fails fast on a
 # race/host-sync/recompile-hazard/broad-except finding before the
@@ -65,6 +65,20 @@ test-spec:
 bench-spec:
 	BENCH_ONLY=speculative python bench.py
 
+# Disaggregated-serving tests only (KV page shipping wire format +
+# integrity, shipped-lane byte parity, role routing with the recompute
+# failure ladder, sticky sessions, SSE streaming incl. disconnect
+# hygiene).
+test-disagg:
+	python -m pytest tests/ -q -m disagg
+
+# Disaggregated-serving bench row: mixed long-prompt + short-chat storm,
+# 1 prefill + 2 decode workers vs 3 undifferentiated — gates decode-side
+# p99 TTFT improvement and failed == 0 with a prefill worker killed
+# mid-storm (docs/architecture.md "Disaggregated serving").
+bench-disagg:
+	BENCH_ONLY=disagg python bench.py
+
 # Observability-plane tests only (metrics registry + exposition,
 # request tracing across the fleet, compile watcher, training
 # telemetry; docs/observability.md).
@@ -117,7 +131,7 @@ smoke:
 # + the overload/admission-control row + the fleet mid-storm-kill row +
 # the paged-KV shared-prefix row).
 serving-smoke:
-	BENCH_ONLY=serving,servinglm,servingoverload,servingfleet,paged,speculative python bench.py
+	BENCH_ONLY=serving,servinglm,servingoverload,servingfleet,paged,speculative,disagg python bench.py
 
 # Precision-plane tests only (bf16-mixed parity/determinism, loss-scaler
 # overflow recovery, int8 serving agreement, dtype round-trips).

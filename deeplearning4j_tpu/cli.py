@@ -499,6 +499,10 @@ def cmd_serve(args) -> int:
             raise SystemExit(
                 "serve: -lm-speculate requires -lm-kv paged "
                 "(speculative rollback rides the page tables)")
+        if args.lm_ship and args.lm_kv != "paged":
+            raise SystemExit(
+                "serve: -lm-ship requires -lm-kv paged (page shipping "
+                "moves block-table pages)")
         cfg, params = _load_saved_lm(pathlib.Path(args.lm))
         srv.serve_lm(cfg, params, slots=args.lm_slots,
                      max_queue_depth=max_queue,
@@ -508,7 +512,8 @@ def cmd_serve(args) -> int:
                      pages=(args.lm_pages if args.lm_pages > 0 else None),
                      prefill_chunk=args.prefill_chunk,
                      speculate=args.lm_speculate,
-                     draft_len=args.draft_len)
+                     draft_len=args.draft_len,
+                     ship=args.lm_ship)
         lm_srv = srv.state.lm_server
         # -warmup opts the LM pool into pre-traffic compiles too, same
         # contract as the classifier path: without it each program
@@ -521,6 +526,7 @@ def cmd_serve(args) -> int:
             spec_note = (f", speculate {lm_srv.speculate} "
                          f"(draft_len {lm_srv.draft_len})"
                          if lm_srv.speculate != "off" else "")
+            spec_note += ", page shipping on" if lm_srv.ship else ""
             print(f"serve: LM registered ({cfg.n_layers}L/d{cfg.d_model}, "
                   f"max_len {cfg.max_len}, {args.lm_slots} decode slots, "
                   f"paged KV: {lm_srv.kv_pages} pages x "
@@ -596,10 +602,23 @@ def cmd_serve_fleet(args) -> int:
 
     from deeplearning4j_tpu.serving import FleetRouter, FleetServer
 
-    if not args.model:
-        raise SystemExit("serve-fleet needs -model")
+    if not args.model and not args.lm:
+        raise SystemExit("serve-fleet needs -model and/or -lm")
     if args.replicas < 1:
         raise SystemExit(f"-replicas must be >= 1, got {args.replicas}")
+    role_split = args.prefill_workers > 0 or args.decode_workers > 0
+    if role_split:
+        # disaggregated prefill/decode fleet (ISSUE-14): role scheduling
+        # is an LM feature — prefill workers chew prompts and ship KV
+        # pages; a classifier-only fleet has nothing to split
+        if not args.lm:
+            raise SystemExit(
+                "serve-fleet: -prefill-workers/-decode-workers need -lm")
+        if args.prefill_workers < 1 or args.decode_workers < 1:
+            raise SystemExit(
+                "serve-fleet: a disaggregated fleet needs BOTH "
+                "-prefill-workers >= 1 and -decode-workers >= 1 "
+                f"(got {args.prefill_workers}/{args.decode_workers})")
     max_queue = args.max_queue if args.max_queue > 0 else None
     deadline_s = args.deadline_ms / 1e3 if args.deadline_ms > 0 else None
     breaker_n = (args.breaker_threshold if args.breaker_threshold > 0
@@ -609,49 +628,72 @@ def cmd_serve_fleet(args) -> int:
     if args.processes:
         return _serve_fleet_processes(args, max_queue=max_queue,
                                       breaker_n=breaker_n,
-                                      quantize=quantize)
+                                      quantize=quantize,
+                                      role_split=role_split)
 
     from deeplearning4j_tpu.nn.conf import DenseLayerConf
     from deeplearning4j_tpu.serving import BucketLadder, spawn_local_replica
 
-    net = _build_net(args.model)
+    net = _build_net(args.model) if args.model else None
+    lm_pair = _load_saved_lm(pathlib.Path(args.lm)) if args.lm else None
     buckets = tuple(int(b) for b in args.buckets.split(","))
-    first = net.conf.layers[0]
-    # same flat-input rule as cmd_serve: a [b, n_in] warmup batch only
-    # makes sense for dense stacks
-    flat = isinstance(first, DenseLayerConf) and first.n_in
-    warmup_example = (np.zeros((int(first.n_in),), np.float32)
-                     if args.warmup and flat else None)
-    if args.warmup and not flat:
-        print("serve-fleet: -warmup skipped (non-flat input layer "
-              f"{type(first).__name__}); the first request per bucket "
-              "compiles instead")
+    warmup_example = None
+    if net is not None:
+        first = net.conf.layers[0]
+        # same flat-input rule as cmd_serve: a [b, n_in] warmup batch
+        # only makes sense for dense stacks
+        flat = isinstance(first, DenseLayerConf) and first.n_in
+        warmup_example = (np.zeros((int(first.n_in),), np.float32)
+                          if args.warmup and flat else None)
+        if args.warmup and not flat:
+            print("serve-fleet: -warmup skipped (non-flat input layer "
+                  f"{type(first).__name__}); the first request per "
+                  "bucket compiles instead")
 
-    def factory(name: str):
+    def spawn(name: str, role: str):
         ladder = BucketLadder(buckets)
         return spawn_local_replica(
             name, net, host=args.host, ladder=ladder,
             max_batch=min(args.max_batch, ladder.max_batch),
             max_wait_ms=args.max_wait_ms, warmup_example=warmup_example,
             max_queue_depth=max_queue, default_deadline_s=deadline_s,
-            breaker_threshold=breaker_n, quantize=quantize)
+            breaker_threshold=breaker_n, quantize=quantize,
+            lm=lm_pair, lm_slots=args.lm_slots,
+            lm_page_size=args.page_size,
+            lm_prefill_chunk=args.prefill_chunk,
+            lm_ship=bool(args.lm_ship), role=role)
+
+    def factory(name: str):
+        # autoscale/rolling-swap spawns: decode capacity is what queue
+        # depth buys in a role-split fleet; "both" otherwise
+        return spawn(name, "decode" if role_split else "both")
 
     router = FleetRouter(
-        factory, replicas=args.replicas,
+        factory, replicas=0 if role_split else args.replicas,
         min_replicas=min(args.min_replicas, args.replicas),
         max_replicas=max(args.max_replicas, args.replicas),
-        health_interval_s=args.health_interval_s)
+        health_interval_s=args.health_interval_s,
+        disagg_min_prompt=args.disagg_min_prompt)
+    if role_split:
+        for i in range(args.prefill_workers):
+            router.attach(spawn(f"prefill-{i}", "prefill"))
+        for i in range(args.decode_workers):
+            router.attach(spawn(f"decode-{i}", "decode"))
     router.autoscale = bool(args.autoscale)
     front = FleetServer(router, host=args.host, port=args.port).start()
     router.start_health_loop()
-    names = ", ".join(r.name for r in router.replicas())
-    print(f"serve-fleet: {args.replicas} warm replicas in rotation "
+    names = ", ".join(f"{r.name}[{r.role}]" if r.role != "both"
+                      else r.name for r in router.replicas())
+    n_total = len(router.replicas())
+    print(f"serve-fleet: {n_total} warm replicas in rotation "
           f"({names}); health every {args.health_interval_s}s; "
           f"autoscale {'on' if args.autoscale else 'off'} "
-          f"[{router.min_replicas}, {router.max_replicas}]")
-    print(f"Serving fleet on {front.url} — POST /model/predict; "
-          f"GET /fleet/stats, /serving/stats, /metrics, /trace/recent, "
-          f"/healthz, /readyz")
+          f"[{router.min_replicas}, {router.max_replicas}]"
+          + (f"; disagg: prompts >= {args.disagg_min_prompt} tokens "
+             f"split prefill->decode" if role_split else ""))
+    print(f"Serving fleet on {front.url} — POST /model/predict, "
+          f"/lm/generate; GET /fleet/stats, /serving/stats, /metrics, "
+          f"/trace/recent, /healthz, /readyz")
 
     # SIGTERM -> fleet-wide graceful drain: the front stops admission
     # (503 + /readyz not-ready), every replica drains its in-flight
@@ -693,7 +735,8 @@ def cmd_serve_fleet(args) -> int:
     return 0
 
 
-def _serve_fleet_processes(args, *, max_queue, breaker_n, quantize) -> int:
+def _serve_fleet_processes(args, *, max_queue, breaker_n, quantize,
+                           role_split: bool = False) -> int:
     """`serve-fleet -processes`: each replica is a real spawned
     `dl4j serve` worker process on `worker-base-port + i`, supervised
     end-to-end by a `FleetSupervisor` — crash detection (exit status +
@@ -716,15 +759,30 @@ def _serve_fleet_processes(args, *, max_queue, breaker_n, quantize) -> int:
         print("serve-fleet: -autoscale ignored with -processes (worker "
               "count is the launcher's; scale by respawning with more "
               "replicas)")
+    if role_split:
+        # worker i in [0, P) is a prefill worker, the rest decode — the
+        # role is ROUTER policy stamped on each incarnation's replica;
+        # every worker runs the same `dl4j serve -lm ... -lm-ship` line
+        n_workers = args.prefill_workers + args.decode_workers
+        roles = (["prefill"] * args.prefill_workers
+                 + ["decode"] * args.decode_workers)
+    else:
+        n_workers, roles = args.replicas, None
     launcher = FleetProcessLauncher(
-        args.model, n_replicas=args.replicas, host=args.host,
+        args.model or None, n_replicas=n_workers, host=args.host,
         base_port=args.worker_base_port, buckets=args.buckets,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         warmup=args.warmup, max_queue=max_queue,
         deadline_ms=(args.deadline_ms if args.deadline_ms > 0 else None),
         breaker_threshold=breaker_n, quantize=quantize,
-        log_dir=args.worker_log_dir)
-    router = FleetRouter(health_interval_s=args.health_interval_s)
+        log_dir=args.worker_log_dir, lm_dir=args.lm or None,
+        lm_slots=(args.lm_slots if args.lm else None),
+        lm_page_size=(args.page_size if args.lm else None),
+        prefill_chunk=(args.prefill_chunk if args.lm else None),
+        lm_ship=bool(args.lm and (role_split or args.lm_ship)),
+        roles=roles)
+    router = FleetRouter(health_interval_s=args.health_interval_s,
+                         disagg_min_prompt=args.disagg_min_prompt)
     supervisor = FleetSupervisor(
         router,
         policy=RestartPolicy(
@@ -735,9 +793,11 @@ def _serve_fleet_processes(args, *, max_queue, breaker_n, quantize) -> int:
         ready_timeout_s=args.ready_timeout_s)
     supervisor.manage_launcher(launcher)
     supervisor.start()
-    print(f"serve-fleet: spawned {args.replicas} worker process(es) on "
-          f"ports {launcher.port(0)}..{launcher.port(args.replicas - 1)} "
-          f"(logs under {launcher.log_dir}); waiting for /readyz "
+    print(f"serve-fleet: spawned {n_workers} worker process(es) on "
+          f"ports {launcher.port(0)}..{launcher.port(n_workers - 1)} "
+          + (f"({args.prefill_workers} prefill + {args.decode_workers} "
+             f"decode) " if role_split else "")
+          + f"(logs under {launcher.log_dir}); waiting for /readyz "
           f"(timeout {args.ready_timeout_s}s)")
     try:
         ready = supervisor.wait_all_ready(args.ready_timeout_s)
@@ -763,7 +823,7 @@ def _serve_fleet_processes(args, *, max_queue, breaker_n, quantize) -> int:
         router.stop()
         raise
     router.start_health_loop()
-    print(f"serve-fleet: {args.replicas} supervised worker processes in "
+    print(f"serve-fleet: {n_workers} supervised worker processes in "
           f"rotation; restart backoff {args.restart_backoff_s}s, "
           f"crash-loop quarantine at {args.crash_loop_threshold} deaths "
           f"in {args.crash_loop_window_s}s; supervision every "
@@ -1311,6 +1371,14 @@ def build_parser() -> argparse.ArgumentParser:
                          dest="prefill_chunk", type=int, default=8,
                          help="max prompt tokens fed per dispatch "
                               "during prefill (1 = token-at-a-time)")
+    p_serve.add_argument("-lm-ship", "--lm-ship", dest="lm_ship",
+                         action="store_true",
+                         help="speak the KV page-shipping wire plane "
+                              "(POST /lm/prefill export + "
+                              "/lm/admit_pages import) so this worker "
+                              "can serve a disaggregated prefill/"
+                              "decode fleet (paged KV only; "
+                              "docs/architecture.md)")
     p_serve.add_argument("-serve-seconds", "--serve-seconds",
                          dest="serve_seconds", type=float, default=0,
                          help="stop after this many seconds (0 = run "
@@ -1321,10 +1389,56 @@ def build_parser() -> argparse.ArgumentParser:
         "serve-fleet", help="serve a saved model through N replicated "
         "engines behind a failover router with health ejection and "
         "fleet-wide SIGTERM drain")
-    p_fleet.add_argument("-model", "--model", required=True,
+    p_fleet.add_argument("-model", "--model", default=None,
                          help="saved model dir, conf JSON, or zoo:<name>")
+    p_fleet.add_argument("-lm", "--lm", default=None,
+                         help="saved LM dir (from `dl4j lm`) served by "
+                              "every replica's continuous pool for "
+                              "POST /lm/generate (paged KV, page "
+                              "shipping enabled)")
     p_fleet.add_argument("-replicas", "--replicas", type=int, default=2,
-                         help="replicas spawned into rotation (default 2)")
+                         help="replicas spawned into rotation (default "
+                              "2); ignored when -prefill-workers/"
+                              "-decode-workers define a role-split "
+                              "fleet")
+    p_fleet.add_argument("-prefill-workers", "--prefill-workers",
+                         dest="prefill_workers", type=int, default=0,
+                         help="disaggregated serving: replicas "
+                              "dedicated to chewing long prompts and "
+                              "shipping the finished KV pages to "
+                              "decode workers (needs -lm and "
+                              "-decode-workers; docs/architecture.md "
+                              "'Disaggregated serving')")
+    p_fleet.add_argument("-decode-workers", "--decode-workers",
+                         dest="decode_workers", type=int, default=0,
+                         help="disaggregated serving: replicas running "
+                              "the latency-bound token loop (they also "
+                              "take short-prompt traffic directly)")
+    p_fleet.add_argument("-disagg-min-prompt", "--disagg-min-prompt",
+                         dest="disagg_min_prompt", type=int, default=32,
+                         help="prompts at least this long split "
+                              "prefill->decode when prefill workers "
+                              "exist; shorter ones decode directly")
+    p_fleet.add_argument("-lm-slots", "--lm-slots", dest="lm_slots",
+                         type=int, default=4,
+                         help="per-replica continuous-decode lanes for "
+                              "/lm/generate")
+    p_fleet.add_argument("-page-size", "--page-size", dest="page_size",
+                         type=int, default=16,
+                         help="per-replica KV page size (must match "
+                              "across the fleet: shipped pages are "
+                              "geometry-checked)")
+    p_fleet.add_argument("-prefill-chunk", "--prefill-chunk",
+                         dest="prefill_chunk", type=int, default=8,
+                         help="per-replica max prompt tokens fed per "
+                              "prefill dispatch")
+    p_fleet.add_argument("-lm-ship", "--lm-ship", dest="lm_ship",
+                         action="store_true",
+                         help="enable page shipping on undifferentiated "
+                              "(both-role) LM replicas too, so sticky-"
+                              "session spill-over ships pages instead "
+                              "of recomputing (role-split fleets ship "
+                              "implicitly)")
     p_fleet.add_argument("-host", "--host", default="127.0.0.1")
     p_fleet.add_argument("-port", "--port", type=int, default=8080,
                          help="fleet front port (0 = ephemeral); each "

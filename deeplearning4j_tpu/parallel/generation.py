@@ -584,6 +584,57 @@ def make_page_copy(cfg: TransformerConfig, pages: int, page_size: int):
     return _compiled_page_copy(cfg, int(pages), int(page_size))
 
 
+@functools.lru_cache(maxsize=16)
+def _compiled_page_gather(cfg: TransformerConfig, pages: int,
+                          page_size: int):
+    """Export half of KV page shipping (serving/transfer.py): gather a
+    lane's pages OUT of the pool by block-table row, fixed shape so the
+    whole disaggregated serving lifetime runs one compiled program.  The
+    pool is NOT donated — the exporting lane keeps serving from it (and
+    the radix tree keeps the prefix for local reuse)."""
+
+    @jax.jit
+    def gather(cache_k, cache_v, table_row):
+        # table_row: [MP] int32 physical page ids; entries past the
+        # shipped count point at the null page and the host slices them
+        # off before serialization
+        return cache_k[:, table_row], cache_v[:, table_row]
+
+    return gather
+
+
+def make_page_gather(cfg: TransformerConfig, pages: int, page_size: int):
+    """Compiled page-gather entry: fn(k, v, table_row [MP]) ->
+    (pages_k [L, MP, ps, H, K], pages_v)."""
+    return _compiled_page_gather(cfg, int(pages), int(page_size))
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_page_install(cfg: TransformerConfig, pages: int,
+                           page_size: int):
+    """Import half of KV page shipping: batched page install on top of
+    the `make_page_copy` idea — scatter a shipped [L, MP, ps, H, K] page
+    stack INTO the donated pool at the block-table row's physical ids,
+    all pages in ONE dispatch.  Rows past `n` land on the reserved null
+    page (whose contents are garbage by design), so the program shape
+    never depends on how many pages actually shipped."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def install(cache_k, cache_v, pages_k, pages_v, table_row, n):
+        mp = table_row.shape[0]
+        dst = jnp.where(jnp.arange(mp) < n, table_row, 0)
+        return cache_k.at[:, dst].set(pages_k), cache_v.at[:, dst].set(
+            pages_v)
+
+    return install
+
+
+def make_page_install(cfg: TransformerConfig, pages: int, page_size: int):
+    """Compiled page-install entry: fn(k, v, pages_k [L, MP, ps, H, K],
+    pages_v, table_row [MP], n) -> (k, v)."""
+    return _compiled_page_install(cfg, int(pages), int(page_size))
+
+
 # ---------------------------------------------------------------------------
 # Beam search (extension: the reference has no generative inference at all)
 

@@ -232,7 +232,8 @@ def kill_process_tree(proc: subprocess.Popen,
         pass
 
 
-def replica_serve_command(model_dir: str, *, host: str = "127.0.0.1",
+def replica_serve_command(model_dir: Optional[str], *,
+                          host: str = "127.0.0.1",
                           port: int = 8081, buckets: str = "1,8,32",
                           max_batch: int = 32, max_wait_ms: float = 2.0,
                           warmup: bool = True,
@@ -240,17 +241,40 @@ def replica_serve_command(model_dir: str, *, host: str = "127.0.0.1",
                           deadline_ms: Optional[float] = None,
                           breaker_threshold: Optional[int] = None,
                           quantize: Optional[str] = None,
+                          lm_dir: Optional[str] = None,
+                          lm_slots: Optional[int] = None,
+                          lm_page_size: Optional[int] = None,
+                          prefill_chunk: Optional[int] = None,
+                          lm_ship: bool = False,
                           python: Optional[str] = None) -> List[str]:
     """The command line for ONE process-hosted serving replica: a
     `dl4j serve` worker on its own port, with graceful SIGTERM drain
     built in (cli.py), ready to be attached to a `FleetRouter` by URL.
     Command GENERATION is in-scope and tested; `FleetProcessLauncher`
     spawns them for real deployments."""
+    if not model_dir and not lm_dir:
+        raise ValueError("replica_serve_command needs model_dir and/or "
+                         "lm_dir (a worker with neither serves nothing)")
     cmd = [python or sys.executable, "-m", "deeplearning4j_tpu.cli",
-           "serve", "-model", str(model_dir), "-host", host,
+           "serve", "-host", host,
            "-port", str(int(port)), "-buckets", buckets,
            "-max-batch", str(int(max_batch)),
            "-max-wait-ms", str(float(max_wait_ms))]
+    if model_dir:
+        cmd += ["-model", str(model_dir)]
+    if lm_dir:
+        # LM worker knobs (ISSUE-14): role-split fleets run LM pools in
+        # their workers; the role itself is ROUTER state (WorkerSpec),
+        # not a worker flag — every worker serves the same surface
+        cmd += ["-lm", str(lm_dir)]
+        if lm_slots is not None:
+            cmd += ["-lm-slots", str(int(lm_slots))]
+        if lm_page_size is not None:
+            cmd += ["-page-size", str(int(lm_page_size))]
+        if prefill_chunk is not None:
+            cmd += ["-prefill-chunk", str(int(prefill_chunk))]
+        if lm_ship:
+            cmd.append("-lm-ship")
     if warmup:
         cmd.append("-warmup")
     # `is not None`, not truthiness: the serve parser documents 0 as
@@ -285,7 +309,7 @@ class FleetProcessLauncher:
     dominate; process-path acceptance runs against the stdlib stub
     worker (`serving/_stub_worker.py`)."""
 
-    model_dir: str
+    model_dir: Optional[str]
     n_replicas: int = 2
     host: str = "127.0.0.1"
     base_port: int = 8081
@@ -297,6 +321,16 @@ class FleetProcessLauncher:
     deadline_ms: Optional[float] = None
     breaker_threshold: Optional[int] = None
     quantize: Optional[str] = None
+    # LM serving + disaggregated roles (ISSUE-14): when `roles` is set
+    # (one entry per worker: "prefill"/"decode"/"both"), worker i's
+    # router-side replica carries roles[i]; the worker COMMANDS are
+    # identical either way — role is routing policy, not worker config
+    lm_dir: Optional[str] = None
+    lm_slots: Optional[int] = None
+    lm_page_size: Optional[int] = None
+    prefill_chunk: Optional[int] = None
+    lm_ship: bool = False
+    roles: Optional[List[str]] = None
     # per-worker stdout/stderr capture (None = inherit the launcher's):
     # {log_dir}/worker-{i}.log, size-rotated at spawn
     log_dir: Optional[str] = None
@@ -316,6 +350,16 @@ class FleetProcessLauncher:
     def urls(self) -> List[str]:
         return [self.url(i) for i in range(int(self.n_replicas))]
 
+    def role(self, i: int) -> str:
+        """Router-side role for worker i ("both" when undifferentiated)."""
+        if self.roles is None:
+            return "both"
+        if len(self.roles) != int(self.n_replicas):
+            raise ValueError(
+                f"roles has {len(self.roles)} entries for "
+                f"{self.n_replicas} workers")
+        return self.roles[int(i)]
+
     def command(self, i: int) -> List[str]:
         return replica_serve_command(
             self.model_dir, host=self.host, port=self.port(i),
@@ -323,7 +367,9 @@ class FleetProcessLauncher:
             max_wait_ms=self.max_wait_ms, warmup=self.warmup,
             max_queue=self.max_queue, deadline_ms=self.deadline_ms,
             breaker_threshold=self.breaker_threshold,
-            quantize=self.quantize)
+            quantize=self.quantize, lm_dir=self.lm_dir,
+            lm_slots=self.lm_slots, lm_page_size=self.lm_page_size,
+            prefill_chunk=self.prefill_chunk, lm_ship=self.lm_ship)
 
     def log_path(self, i: int) -> Optional[pathlib.Path]:
         if self.log_dir is None:

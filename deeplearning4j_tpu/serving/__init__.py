@@ -52,6 +52,19 @@ coalescing K concurrent *requests* per device dispatch.
   `FleetServer` HTTP front (`/fleet/stats`) and `spawn_local_replica`
   for thread-hosted replicas (process-per-replica launching lives in
   `runtime.launcher.FleetProcessLauncher`);
+- disaggregated prefill/decode serving (`transfer.py` + role routing
+  in `fleet.py`, ISSUE-14): `PageExport`/`serialize_export`/
+  `deserialize_export` — the SHA-256-checked KV page shipping wire
+  format; `ContinuousLMServer(ship=True)` grows
+  `prefill_export`/`admit_with_pages` so prefill-role workers chew
+  long prompts and ship the finished pages to the decode worker the
+  router picked up front (failure ladder: dead prefill worker ->
+  resubmit to a peer; corrupt/rejected shipment -> recompute locally;
+  zero failed requests); sticky `session_id` rendezvous affinity keeps
+  multi-turn chats on the replica holding their pages with spill-over
+  served by shipping; SSE token streaming on `/lm/generate`
+  (`"stream": true`) makes time-to-first-token a first-class
+  measurement (docs/architecture.md "Disaggregated serving");
 - process supervision (`procfleet.py`, ISSUE-10): `FleetSupervisor`
   owns spawned worker processes end-to-end — exit-status + `/readyz`
   crash detection with clean/crash/wedged classification, exponential
@@ -82,6 +95,9 @@ from deeplearning4j_tpu.serving.fleet import (
     FleetClientError,
     FleetRouter,
     FleetServer,
+    ROLE_BOTH,
+    ROLE_DECODE,
+    ROLE_PREFILL,
     Replica,
     check_fleet_ledger,
     spawn_local_replica,
@@ -108,6 +124,13 @@ from deeplearning4j_tpu.serving.resilience import (
     ServingUnavailableError,
     UnservableShapeError,
 )
+from deeplearning4j_tpu.serving.transfer import (
+    PageExport,
+    PageShipError,
+    check_compatible,
+    deserialize_export,
+    serialize_export,
+)
 
 __all__ = [
     "BucketLadder",
@@ -125,7 +148,12 @@ __all__ = [
     "MicroBatcher",
     "ModelDrafter",
     "NgramDrafter",
+    "PageExport",
+    "PageShipError",
     "RestartPolicy",
+    "ROLE_BOTH",
+    "ROLE_DECODE",
+    "ROLE_PREFILL",
     "PageLeakError",
     "PagePool",
     "RadixPrefixCache",
@@ -137,7 +165,10 @@ __all__ = [
     "ServingUnavailableError",
     "UnservableShapeError",
     "WorkerSpec",
+    "check_compatible",
     "check_fleet_ledger",
+    "deserialize_export",
     "pow2_length_buckets",
+    "serialize_export",
     "spawn_local_replica",
 ]

@@ -41,6 +41,20 @@ for: serving that survives any single worker dying.
     `scale_down_depth` drains one out gracefully, bounded by
     `[min_replicas, max_replicas]`.
 
+- **Disaggregated prefill/decode roles** (ISSUE-14): replicas carry a
+  `role` — `prefill` workers chew long prompts chunk-by-chunk and ship
+  the finished KV pages (`serving/transfer.py`) to the `decode` worker
+  the router picked up front; `decode`/`both` workers run the token
+  loop and take short-prompt traffic directly.  Sticky `session_id`
+  rendezvous affinity keeps a multi-turn chat on the replica holding
+  its pages; spill-over off an overloaded preferred replica is served
+  by page shipping (prefill on the cache-hot replica, decode on the
+  spill target) instead of a cold recompute.  The failure ladder never
+  fails a request: dead prefill worker -> resubmit the prompt to a
+  peer; rejected/corrupt shipment or no prefill capacity -> recompute
+  on a decode worker.  `open_lm_stream` routes SSE token streams the
+  same way.
+
 - `FleetServer` — the fleet's own HTTP front (`/model/predict`,
   `/lm/generate`, `/fleet/stats`, `/serving/stats`, `/healthz`,
   `/readyz`) with the same typed-failure -> status mapping as
@@ -59,6 +73,7 @@ rolling-swap timeline.
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import http.client
 import json
@@ -126,6 +141,19 @@ REPLICA_ACTIVE = "active"
 REPLICA_DRAINING = "draining"
 REPLICA_STOPPED = "stopped"
 
+# Worker roles (ISSUE-14 disaggregated serving): prefill workers chew
+# long prompts and ship finished KV pages; decode workers run the token
+# loop (and take short-prompt traffic directly); "both" is the classic
+# undifferentiated worker.  Role routing only constrains LM traffic —
+# classifier dispatch stays role-agnostic.
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_BOTH = "both"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_BOTH)
+# which roles may serve each side of the split
+_PREFILL_ROLES = (ROLE_PREFILL,)
+_DECODE_ROLES = (ROLE_DECODE, ROLE_BOTH)
+
 
 class Replica:
     """One serving endpoint in the fleet.
@@ -139,13 +167,17 @@ class Replica:
     """
 
     def __init__(self, name: str, url: str, server=None, process=None,
-                 breaker: Optional[CircuitBreaker] = None, version: int = 0):
+                 breaker: Optional[CircuitBreaker] = None, version: int = 0,
+                 role: str = ROLE_BOTH):
         self.name = str(name)
         self.url = url.rstrip("/")
         self.server = server
         self.process = process
         self.breaker = breaker
         self.version = int(version)
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        self.role = role
         self.lock = threading.Lock()
         self.state = REPLICA_ACTIVE
         self.in_flight = 0      # router-side queue-depth proxy
@@ -221,6 +253,7 @@ class Replica:
     def summary(self) -> Dict:
         with self.lock:
             out = {"name": self.name, "url": self.url, "state": self.state,
+                   "role": self.role,
                    "version": self.version, "in_flight": self.in_flight,
                    "dispatches": self.dispatches, "failures": self.failures,
                    "ejections": self.ejections,
@@ -243,6 +276,8 @@ def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
                         lm_prefill_chunk: int = 8,
                         lm_speculate: str = "off",
                         lm_draft_len: int = 4,
+                        lm_ship: bool = False,
+                        role: str = ROLE_BOTH,
                         version: int = 0) -> Replica:
     """Thread-hosted replica: an in-process `UiServer` on a free port
     with its own engine surface (`/model/predict`, `/lm/generate`,
@@ -268,6 +303,10 @@ def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
             breaker_cooldown_s=breaker_cooldown_s, quantize=quantize)
     if lm is not None:
         cfg, params = lm
+        # a role-differentiated worker always speaks the page-shipping
+        # wire plane — that is what its role MEANS; undifferentiated
+        # workers opt in via lm_ship (sticky-session spill-over shipping)
+        ship = bool(lm_ship) or role != ROLE_BOTH
         srv.serve_lm(cfg, params, slots=lm_slots,
                      max_queue_depth=max_queue_depth,
                      default_deadline_s=default_deadline_s,
@@ -275,13 +314,14 @@ def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
                      breaker_cooldown_s=breaker_cooldown_s,
                      kv=lm_kv, page_size=lm_page_size, pages=lm_pages,
                      prefill_chunk=lm_prefill_chunk,
-                     speculate=lm_speculate, draft_len=lm_draft_len)
+                     speculate=lm_speculate, draft_len=lm_draft_len,
+                     ship=ship)
         # warm the paged programs BEFORE the replica enters rotation —
         # same zero-compile-on-the-request-path rule as warmup_example
         if srv.state.lm_server is not None:
             srv.state.lm_server.warmup()
     srv.start()
-    return Replica(name, srv.url, server=srv, version=version)
+    return Replica(name, srv.url, server=srv, version=version, role=role)
 
 
 class FleetRouter:
@@ -303,6 +343,7 @@ class FleetRouter:
                  probe_timeout_s: float = 2.0,
                  affinity_prefix_tokens: int = 8,
                  affinity_spill_depth: int = 8,
+                 disagg_min_prompt: int = 32,
                  min_replicas: int = 1, max_replicas: int = 8,
                  scale_up_depth: float = 4.0,
                  scale_down_depth: float = 0.5,
@@ -316,6 +357,11 @@ class FleetRouter:
         self.probe_timeout_s = float(probe_timeout_s)
         self.affinity_prefix_tokens = int(affinity_prefix_tokens)
         self.affinity_spill_depth = int(affinity_spill_depth)
+        # disaggregation (ISSUE-14): prompts at least this long are
+        # split prefill/decode when prefill-role workers exist; shorter
+        # ones go straight to a decode worker (shipping a page of KV
+        # costs more than prefilling a short prompt locally)
+        self.disagg_min_prompt = int(disagg_min_prompt)
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         self.scale_up_depth = float(scale_up_depth)
@@ -337,6 +383,18 @@ class FleetRouter:
         self.scale_ups = 0
         self.scale_downs = 0
         self.health_polls = 0
+        # disaggregation ledger (ISSUE-14): successful page shipments,
+        # shipments that fell back to a local recompute (integrity /
+        # dead worker / no prefill capacity), sticky-session routing
+        # outcomes, and per-role successful-dispatch counts
+        self.ships = 0
+        self.ship_fallbacks = 0
+        self.session_spill_ships = 0
+        self.session_affinity_hits = 0
+        self._role_requests: Dict[str, int] = {r: 0 for r in ROLES}
+        self._session_route: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict())
+        self._session_capacity = 4096
         self.autoscale = False   # health loop calls autoscale_tick() too
         # process supervision (ISSUE-10): a FleetSupervisor installs
         # itself here so /fleet/stats carries the supervision section
@@ -427,16 +485,20 @@ class FleetRouter:
                                digest_size=8).digest()
 
     def _pick(self, excluded: frozenset = frozenset(),
-              key: Optional[str] = None) -> Optional[Replica]:
+              key: Optional[str] = None,
+              roles: Optional[Sequence[str]] = None) -> Optional[Replica]:
         """Choose a replica for one dispatch attempt.  Least-loaded by
         router-side in-flight (ties broken deterministically by name);
         with an affinity `key`, rendezvous hashing picks a preferred
         replica that stays stable under membership changes, spilling to
         least-loaded only when the preferred one is backed up by more
-        than `affinity_spill_depth` requests over the least loaded."""
+        than `affinity_spill_depth` requests over the least loaded.
+        `roles` restricts candidacy (the disaggregated LM split);
+        None = role-agnostic (classifier traffic)."""
         with self._lock:
             candidates = [r for r in self._replicas
-                          if r.routable() and r.name not in excluded]
+                          if r.routable() and r.name not in excluded
+                          and (roles is None or r.role in roles)]
         if not candidates:
             return None
         # a half-open replica is ejected-pending-probe, not healthy: its
@@ -462,26 +524,42 @@ class FleetRouter:
 
     def _http(self, method: str, url: str, body=None,
               timeout: Optional[float] = None,
-              headers: Optional[Dict[str, str]] = None):
-        data = None if body is None else json.dumps(body).encode()
+              headers: Optional[Dict[str, str]] = None,
+              raw_body: Optional[bytes] = None,
+              raw_response: bool = False):
+        """One HTTP exchange.  JSON in/out by default; `raw_body` sends
+        an octet-stream request (a KV page shipment), `raw_response`
+        returns the body bytes unparsed (a shipment coming back)."""
+        if raw_body is not None:
+            data, ctype = raw_body, "application/octet-stream"
+        else:
+            data = None if body is None else json.dumps(body).encode()
+            ctype = "application/json"
         req = urllib.request.Request(
             url, data=data, method=method,
-            headers={"Content-Type": "application/json",
-                     **(headers or {})})
+            headers={"Content-Type": ctype, **(headers or {})})
         with urllib.request.urlopen(
                 req, timeout=(timeout if timeout is not None
                               else self.request_timeout_s)) as resp:
-            return resp.status, json.loads(resp.read() or b"{}")
+            raw = resp.read()
+            if raw_response:
+                return resp.status, raw
+            return resp.status, json.loads(raw or b"{}")
 
     def _dispatch(self, replica: Replica, path: str, body,
                   timeout: Optional[float] = None,
-                  request_id: Optional[str] = None):
+                  request_id: Optional[str] = None,
+                  raw_body: Optional[bytes] = None,
+                  raw_response: bool = False,
+                  deadline_ms: Optional[float] = None):
         """One dispatch attempt against one replica.  Raises
         `FleetClientError` (4xx — never retried) or
         `_ReplicaDispatchError` (failover) on failure; feeds the
         replica's breaker and router-side counters.  `request_id` is
         forwarded as ``X-Request-Id`` so the replica's serving plane
-        traces under the SAME id — including on failover resubmission."""
+        traces under the SAME id — including on failover resubmission.
+        `raw_body`/`raw_response` carry the binary page-shipping legs
+        through the same breaker/counter discipline."""
         if (replica.breaker is not None
                 and not replica.breaker.allow_dispatch()):
             # half-open single-probe discipline (same as batcher/lm):
@@ -495,11 +573,18 @@ class FleetRouter:
         with replica.lock:
             replica.in_flight += 1
         try:
+            headers = {}
+            if request_id:
+                headers["X-Request-Id"] = request_id
+            if deadline_ms is not None:
+                # binary legs cannot carry deadline_ms in a JSON body:
+                # the remaining budget rides the header instead
+                headers["X-Deadline-Ms"] = f"{deadline_ms:.0f}"
             try:
                 _, payload = self._http(
                     "POST", replica.url + path, body, timeout,
-                    headers=({"X-Request-Id": request_id}
-                             if request_id else None))
+                    headers=headers or None,
+                    raw_body=raw_body, raw_response=raw_response)
             except urllib.error.HTTPError as e:
                 status = e.code
                 try:
@@ -552,17 +637,24 @@ class FleetRouter:
             replica.breaker.record_success()
         with replica.lock:
             replica.dispatches += 1
+        with self._lock:
+            self._role_requests[replica.role] = (
+                self._role_requests.get(replica.role, 0) + 1)
         return payload
 
     def _submit(self, path: str, body, key: Optional[str] = None,
                 timeout: Optional[float] = None,
-                request_id: Optional[str] = None):
+                request_id: Optional[str] = None,
+                roles: Optional[Sequence[str]] = None,
+                session_id: Optional[str] = None):
         """Failover loop: try routable replicas (excluded set grows per
         failure) until one answers or none remain.  Predict is pure, so
         resubmitting a failed dispatch elsewhere is always safe.  The
         whole loop is ONE trace under `request_id` (minted here when the
         caller has none): one span per dispatch attempt plus a
-        failover_hop span per resubmission."""
+        failover_hop span per resubmission.  `session_id` is noted
+        against the replica that ACTUALLY answered — a failover must
+        not leave the sticky-session map pointing at a corpse."""
         t0 = time.perf_counter()
         rid = request_id or new_request_id()
         spans: List[Dict] = []
@@ -594,7 +686,7 @@ class FleetRouter:
                         f"after {len(excluded)} failover(s)"
                         + (f" (last failure: {last})" if last else ""))
                 body["deadline_ms"] = remaining
-            replica = self._pick(frozenset(excluded), key)
+            replica = self._pick(frozenset(excluded), key, roles=roles)
             if replica is None:
                 break
             ta = time.perf_counter()
@@ -627,6 +719,7 @@ class FleetRouter:
             spans.append(span("dispatch", ta, time.perf_counter(),
                               replica=replica.name, outcome="ok"))
             self.metrics.record_request(time.perf_counter() - t0)
+            self._note_session_route(session_id, replica)
             finish("ok")
             return payload
         self.metrics.record_rejected()
@@ -659,27 +752,74 @@ class FleetRouter:
                                             request_id=request_id),
                          axis=-1)
 
+    def _lm_affinity_key(self, ids: Sequence[int],
+                         session_id: Optional[str]) -> str:
+        """The rendezvous key for one LM request: sticky `session_id`
+        when the client sent one (a multi-turn chat keeps landing on
+        the replica holding its pages — its prompts GROW every turn, so
+        prefix hashing alone would eventually re-route it), else the
+        prompt's first `affinity_prefix_tokens` tokens."""
+        if session_id is not None:
+            return f"session:{session_id}"
+        return ",".join(map(str, ids[:self.affinity_prefix_tokens]))
+
+    def _note_session_route(self, session_id: Optional[str],
+                            replica: Replica) -> None:
+        """Router-side sticky-session accounting: a session that lands
+        on the same replica as its previous turn is an affinity hit."""
+        if session_id is None:
+            return
+        with self._lock:
+            prev = self._session_route.get(session_id)
+            if prev is not None:
+                self._session_route.move_to_end(session_id)
+                if prev == replica.name:
+                    self.session_affinity_hits += 1
+            self._session_route[session_id] = replica.name
+            while len(self._session_route) > self._session_capacity:
+                self._session_route.popitem(last=False)
+
+    def _has_role(self, role: str) -> bool:
+        with self._lock:
+            return any(r.role == role and r.routable()
+                       for r in self._replicas)
+
     def generate_payload(self, prompt_ids: Sequence[int],
                          max_new_tokens: int, temperature: float = 0.0,
                          seed: int = 0, top_k: int = 0, top_p: float = 1.0,
                          beam_size: int = 0,
                          deadline_s: Optional[float] = None,
                          timeout: Optional[float] = None,
-                         request_id: Optional[str] = None) -> Dict:
-        """LM generation with prefix-affinity routing: the first
+                         request_id: Optional[str] = None,
+                         session_id: Optional[str] = None) -> Dict:
+        """LM generation with affinity routing and role scheduling.
+
+        Affinity: a sticky `session_id` (when sent) or the first
         `affinity_prefix_tokens` prompt tokens pick the preferred
-        replica via rendezvous hashing, so a shared system prompt keeps
-        hitting the same replica's (future) prefix cache.  Returns the
-        replica's full JSON answer (`ids`, plus `score` on the beam
-        path).  top-k / top-p / beam forward to the replica's
-        whole-sequence leg (ui/server.py routes them off the continuous
-        pool); every mode is seeded and deterministic, so failover
-        resubmission stays safe for all of them."""
+        DECODE-capable replica via rendezvous hashing, so a shared
+        system prompt — or a whole conversation — keeps hitting the
+        same replica's prefix cache.  Roles (ISSUE-14): when
+        prefill-role workers exist and the prompt is at least
+        `disagg_min_prompt` tokens, the request is split — a prefill
+        worker chews the prompt and ships the finished KV pages to the
+        decode replica picked up front; short prompts go straight to
+        decode workers.  A sticky session spilling off its overloaded
+        preferred replica is served by page shipping (prefill on the
+        replica holding its radix pages, decode on the spill target)
+        instead of a cold recompute.  Every ship failure — integrity,
+        dead worker, dry pool — falls back down the ladder to a local
+        recompute on a decode worker: zero failed requests by
+        construction.  Returns the replica's full JSON answer (`ids`,
+        plus `score` on the beam path).  top-k / top-p / beam forward
+        to the replica's whole-sequence leg; every mode is seeded and
+        deterministic, so failover resubmission stays safe."""
         ids = [int(t) for t in prompt_ids]
-        key = ",".join(map(str, ids[:self.affinity_prefix_tokens]))
+        key = self._lm_affinity_key(ids, session_id)
         body: Dict = {"prompt_ids": ids,
                       "max_new_tokens": int(max_new_tokens),
                       "temperature": float(temperature), "seed": int(seed)}
+        if session_id is not None:
+            body["session_id"] = str(session_id)
         if int(top_k):
             body["top_k"] = int(top_k)
         if float(top_p) < 1.0:
@@ -688,18 +828,356 @@ class FleetRouter:
             body["beam_size"] = int(beam_size)
         if deadline_s is not None:
             body["deadline_ms"] = float(deadline_s) * 1e3
-        return self._submit("/lm/generate", body, key=key, timeout=timeout,
-                            request_id=request_id)
+        whole_sequence = (int(top_k) > 0 or float(top_p) < 1.0
+                          or int(beam_size) > 1)
+        long_prompt = len(ids) >= self.disagg_min_prompt
+        if not whole_sequence and long_prompt:
+            if self._has_role(ROLE_PREFILL):
+                # role split: prefill workers exist for this prompt
+                return self._submit_disagg(body, key, timeout=timeout,
+                                           request_id=request_id,
+                                           session_id=session_id)
+            # spill-over candidacy only matters for long prompts on a
+            # shipping-capable fleet — short prompts skip the extra
+            # pick entirely and go straight to the submit loop
+            replica, spilled, preferred = self._pick_decode(key)
+            if (spilled and preferred is not None
+                    and self._replica_ships(preferred)):
+                # sticky-session spill-over (ISSUE-14): the preferred
+                # replica holds this conversation's radix pages but is
+                # backed up — prefill THERE (radix-cheap), ship the
+                # pages to the spill target instead of recomputing cold
+                with self._lock:
+                    self.session_spill_ships += 1
+                return self._submit_disagg(body, key, timeout=timeout,
+                                           request_id=request_id,
+                                           session_id=session_id,
+                                           prefill_pref=preferred,
+                                           decode_pref=replica)
+        return self._submit("/lm/generate", body, key=key,
+                            timeout=timeout, request_id=request_id,
+                            roles=_DECODE_ROLES, session_id=session_id)
+
+    def _pick_decode(self, key: str):
+        """The decode-side pick with the spill decision made visible:
+        returns (chosen, spilled, preferred) where `spilled` means the
+        rendezvous-preferred replica was passed over for load."""
+        chosen = self._pick(key=key, roles=_DECODE_ROLES)
+        if chosen is None:
+            return None, False, None
+        with self._lock:
+            pool = [r for r in self._replicas
+                    if r.routable() and r.role in _DECODE_ROLES]
+        if not pool:              # membership raced the pick away
+            return chosen, False, None
+        rendezvous = max(pool, key=lambda r: self._rendezvous_weight(
+            key, r.name))
+        spilled = chosen.name != rendezvous.name
+        return chosen, spilled, rendezvous
+
+    @staticmethod
+    def _replica_ships(replica: Replica) -> bool:
+        """Best-effort: can this replica serve /lm/prefill?  Prefill
+        workers always can; a both-role replica only when its pool was
+        spawned with lm_ship=True — the endpoint answers 400 otherwise
+        and the ladder falls back to recompute, so this check is an
+        optimization, not a correctness gate."""
+        if replica.role == ROLE_PREFILL:
+            return True
+        srv = getattr(replica.server, "state", None)
+        lm = getattr(srv, "lm_server", None) if srv is not None else None
+        return bool(getattr(lm, "ship", False)) if lm is not None else True
+
+    def _submit_disagg(self, body: Dict, key: str,
+                       timeout: Optional[float] = None,
+                       request_id: Optional[str] = None,
+                       session_id: Optional[str] = None,
+                       prefill_pref: Optional[Replica] = None,
+                       decode_pref: Optional[Replica] = None) -> Dict:
+        """The disaggregated submit: prefill -> ship -> decode, one
+        trace under one X-Request-Id naming the prefill worker, the
+        wire hop, and the decode worker.  The failure ladder never
+        fails the request: a dead/failing prefill worker resubmits the
+        prompt to a peer; no peer (or a rejected/corrupt shipment, or a
+        dying decode worker) falls back to a plain /lm/generate on the
+        decode pool — recompute, not error."""
+        t0 = time.perf_counter()
+        rid = request_id or new_request_id()
+        spans: List[Dict] = []
+        # the client's deadline is a TOTAL budget across the whole
+        # prefill -> ship -> decode ladder (same discipline as
+        # `_submit`): each leg gets only what remains of it
+        deadline_ms = (body.get("deadline_ms")
+                       if isinstance(body, dict) else None)
+
+        def _remaining_ms() -> Optional[float]:
+            if deadline_ms is None:
+                return None
+            rem = deadline_ms - (time.perf_counter() - t0) * 1e3
+            if rem <= 0:
+                self.metrics.record_deadline_missed()
+                self.metrics.record_rejected()
+                self.tracer.record(trace(
+                    rid, "fleet", spans, status="timeout",
+                    path="/lm/generate", disagg=True))
+                raise DeadlineExceededError(
+                    f"deadline of {deadline_ms:.0f}ms exhausted "
+                    f"mid-ship")
+            return rem
+
+        decode = decode_pref or self._pick(key=key, roles=_DECODE_ROLES)
+        if decode is None:
+            self.metrics.record_rejected()
+            raise ServingUnavailableError(
+                "no routable decode-capable replica")
+        prefill_body = {k: v for k, v in body.items()
+                        if k not in ("top_k", "top_p", "beam_size")}
+        excluded: set = set()
+        blob = None
+        last: Optional[BaseException] = None
+        while blob is None:
+            rem = _remaining_ms()
+            if rem is not None:
+                prefill_body["deadline_ms"] = rem
+            pre = (prefill_pref
+                   if prefill_pref is not None
+                   and prefill_pref.name not in excluded
+                   and prefill_pref.routable()
+                   else self._pick(frozenset(excluded),
+                                   roles=_PREFILL_ROLES))
+            if pre is None or pre.name == decode.name:
+                # no prefill capacity left (or only the decode replica
+                # itself): recompute locally on the decode side
+                break
+            ta = time.perf_counter()
+            try:
+                blob = self._dispatch(pre, "/lm/prefill", prefill_body,
+                                      timeout, request_id=rid,
+                                      raw_response=True)
+            except FleetClientError as e:
+                # the prefill worker ANSWERED 4xx: a 422 is the typed
+                # "this worker cannot ship" (kind: page_ship) — fall
+                # back to recompute; any other 4xx means the request is
+                # bad everywhere (propagate — recomputing would 400 too)
+                spans.append(span("dispatch", ta, time.perf_counter(),
+                                  replica=pre.name, stage="prefill",
+                                  outcome="4xx"))
+                if e.status == 422:
+                    last = e
+                    break
+                self.metrics.record_rejected()
+                raise
+            except _ReplicaDispatchError as e:
+                # a dead prefill worker's in-flight prompt resubmits to
+                # a peer — the mid-ship-kill acceptance path
+                tb = time.perf_counter()
+                spans.append(span(
+                    "dispatch", ta, tb, replica=pre.name,
+                    stage="prefill",
+                    outcome=("fault" if e.replica_fault
+                             else "unavailable"), error=str(e)[:200]))
+                spans.append(span("failover_hop", tb, tb,
+                                  excluded=pre.name))
+                excluded.add(pre.name)
+                with self._lock:
+                    self.failovers += 1
+                last = e
+                continue
+            spans.append(span("dispatch", ta, time.perf_counter(),
+                              replica=pre.name, stage="prefill",
+                              outcome="ok"))
+        if blob is not None:
+            ts = time.perf_counter()
+            try:
+                payload = self._dispatch(
+                    decode, "/lm/admit_pages", None, timeout,
+                    request_id=rid, raw_body=blob,
+                    deadline_ms=_remaining_ms())
+                td = time.perf_counter()
+                spans.append(span("ship", ts, td, bytes=len(blob),
+                                  decode=decode.name))
+                spans.append(span("dispatch", ts, td,
+                                  replica=decode.name, stage="decode",
+                                  outcome="ok"))
+                with self._lock:
+                    self.ships += 1
+                self.metrics.record_request(time.perf_counter() - t0)
+                self._note_session_route(session_id, decode)
+                self.tracer.record(trace(
+                    rid, "fleet", spans, status="ok",
+                    path="/lm/generate", disagg=True))
+                return payload
+            except (FleetClientError, _ReplicaDispatchError) as e:
+                # rejected shipment (422 integrity/geometry, a pool
+                # that cannot admit) or a decode worker dying mid-admit:
+                # recompute below — never a failed request
+                spans.append(span("dispatch", ts, time.perf_counter(),
+                                  replica=decode.name, stage="decode",
+                                  outcome="ship_rejected",
+                                  error=str(e)[:200]))
+                last = e
+        # --- recompute ladder: plain generate on the decode pool
+        with self._lock:
+            self.ship_fallbacks += 1
+        spans.append(span("failover_hop", time.perf_counter(),
+                          time.perf_counter(), fallback="recompute",
+                          error=(str(last)[:200] if last else None)))
+        self.tracer.record(trace(rid, "fleet", spans,
+                                 status="recompute_fallback",
+                                 path="/lm/generate", disagg=True))
+        rem = _remaining_ms()
+        if rem is not None:
+            # hand the recompute only what the ship attempt left over —
+            # _submit treats body["deadline_ms"] as a fresh total budget
+            body = dict(body, deadline_ms=rem)
+        return self._submit("/lm/generate", body, key=key,
+                            timeout=timeout, request_id=rid,
+                            roles=_DECODE_ROLES, session_id=session_id)
+
+    def open_lm_stream(self, prompt_ids: Sequence[int],
+                       max_new_tokens: int, temperature: float = 0.0,
+                       seed: int = 0, top_k: int = 0,
+                       top_p: float = 1.0, beam_size: int = 0,
+                       deadline_s: Optional[float] = None,
+                       timeout: Optional[float] = None,
+                       request_id: Optional[str] = None,
+                       session_id: Optional[str] = None):
+        """Open one SSE token stream against a decode-capable replica
+        (affinity-routed like `generate_payload`); returns the raw
+        `http.client`-style response object — the caller relays/parses
+        the `text/event-stream` bytes and MUST close it (closing also
+        records the stream's true duration into the router's request
+        latency).  top-k/top-p/beam forward so the replica can answer
+        its typed 400 — silently downgrading a sampled stream to
+        greedy would serve DIFFERENT generations than the
+        single-server surface refuses to.  Failover covers
+        connect-time failures only: once events flow, tokens already
+        reached the client and a resubmission would replay them — a
+        mid-stream death surfaces as a truncated stream."""
+        ids = [int(t) for t in prompt_ids]
+        key = self._lm_affinity_key(ids, session_id)
+        body: Dict = {"prompt_ids": ids,
+                      "max_new_tokens": int(max_new_tokens),
+                      "temperature": float(temperature),
+                      "seed": int(seed), "stream": True}
+        if int(top_k):
+            body["top_k"] = int(top_k)
+        if float(top_p) < 1.0:
+            body["top_p"] = float(top_p)
+        if int(beam_size) > 1:
+            body["beam_size"] = int(beam_size)
+        if session_id is not None:
+            body["session_id"] = str(session_id)
+        if deadline_s is not None:
+            body["deadline_ms"] = float(deadline_s) * 1e3
+        rid = request_id or new_request_id()
+        excluded: set = set()
+        last: Optional[BaseException] = None
+        while True:
+            replica = self._pick(frozenset(excluded), key,
+                                 roles=_DECODE_ROLES)
+            if replica is None:
+                self.metrics.record_rejected()
+                raise ServingUnavailableError(
+                    "no routable decode-capable replica for the stream"
+                    + (f" (last failure: {last})" if last else ""))
+            req = urllib.request.Request(
+                replica.url + "/lm/generate",
+                data=json.dumps(body).encode(), method="POST",
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": rid})
+            # streams feed the SAME replica accounting as _dispatch:
+            # in_flight for the stream's whole lifetime (least-loaded
+            # and spill decisions must see long-lived streams), breaker
+            # verdicts per outcome, dispatches on success — an SSE-heavy
+            # fleet must not fly blind
+            with replica.lock:
+                replica.in_flight += 1
+            try:
+                resp = urllib.request.urlopen(
+                    req, timeout=(timeout if timeout is not None
+                                  else self.request_timeout_s))
+            except urllib.error.HTTPError as e:
+                with replica.lock:
+                    replica.in_flight -= 1
+                detail = b""
+                try:
+                    detail = e.read()
+                except OSError:
+                    pass
+                if 400 <= e.code < 500:
+                    # an answer is liveness evidence, like _dispatch
+                    if replica.breaker is not None:
+                        replica.breaker.record_success()
+                    raise FleetClientError(
+                        detail.decode(errors="replace")
+                        or f"replica {replica.name} answered {e.code}",
+                        status=e.code) from e
+                if replica.breaker is not None:
+                    if e.code in (503, 504):
+                        replica.breaker.abandon_probe()
+                    else:
+                        replica.breaker.record_failure()
+                if e.code not in (503, 504):
+                    with replica.lock:
+                        replica.failures += 1
+                excluded.add(replica.name)
+                with self._lock:
+                    self.failovers += 1
+                last = e
+                continue
+            except (http.client.HTTPException, OSError) as e:
+                with replica.lock:
+                    replica.in_flight -= 1
+                if replica.breaker is not None:
+                    replica.breaker.record_failure()
+                with replica.lock:
+                    replica.failures += 1
+                excluded.add(replica.name)
+                with self._lock:
+                    self.failovers += 1
+                last = e
+                continue
+            if replica.breaker is not None:
+                replica.breaker.record_success()
+            with replica.lock:
+                replica.dispatches += 1
+            with self._lock:
+                self._role_requests[replica.role] = (
+                    self._role_requests.get(replica.role, 0) + 1)
+            self._note_session_route(session_id, replica)
+            # at close (idempotent): release the in-flight claim and
+            # record the stream's TRUE duration — recording 0.0 at
+            # connect would collapse the fleet's latency percentiles
+            # for exactly the TTFT-sensitive traffic streaming exists
+            # for
+            t_open = time.perf_counter()
+            orig_close = resp.close
+            recorded = []
+
+            def close_and_record():
+                if not recorded:
+                    recorded.append(True)
+                    with replica.lock:
+                        replica.in_flight -= 1
+                    self.metrics.record_request(
+                        time.perf_counter() - t_open)
+                orig_close()
+
+            resp.close = close_and_record
+            return resp
 
     def generate(self, prompt_ids: Sequence[int], max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
                  top_k: int = 0, top_p: float = 1.0, beam_size: int = 0,
                  deadline_s: Optional[float] = None,
-                 timeout: Optional[float] = None) -> List[int]:
+                 timeout: Optional[float] = None,
+                 session_id: Optional[str] = None) -> List[int]:
         payload = self.generate_payload(
             prompt_ids, max_new_tokens, temperature=temperature, seed=seed,
             top_k=top_k, top_p=top_p, beam_size=beam_size,
-            deadline_s=deadline_s, timeout=timeout)
+            deadline_s=deadline_s, timeout=timeout,
+            session_id=session_id)
         return list(payload["ids"])
 
     # ---- health: eject -> probe -> re-admit -------------------------------
@@ -880,6 +1358,11 @@ class FleetRouter:
                         "scale_downs": self.scale_downs,
                         "health_polls": self.health_polls,
                         "weights_version": self._version}
+            disagg = {"ships": self.ships,
+                      "ship_fallbacks": self.ship_fallbacks,
+                      "session_spill_ships": self.session_spill_ships,
+                      "session_affinity_hits": self.session_affinity_hits,
+                      "role_requests": dict(self._role_requests)}
             replicas = list(self._replicas)
             retired = {"aggregate": dict(self._retired_agg),
                        "lost": self._retired_lost}
@@ -936,6 +1419,26 @@ class FleetRouter:
             spec["accept_rate"] = round(
                 spec["accepted"] / spec["drafted"], 3)
             fleet["lm_speculate"] = spec
+        # fleet-level disaggregation view (ISSUE-14): router-side ship /
+        # fallback / session counters plus the per-replica pool ship
+        # ledgers (pages_shipped, ship_bytes, ship_ms) and replica-side
+        # session affinity hits aggregated through /serving/stats
+        ship_agg = {"pages_shipped": 0, "ship_bytes": 0, "out": 0,
+                    "in": 0}
+        sess_hits = 0
+        for payload in stats_by_name.values():
+            lm = (payload or {}).get("lm") or {}
+            shp = lm.get("ship") or {}
+            for k in ship_agg:
+                ship_agg[k] += int(shp.get(k) or 0)
+            sess_hits += int(lm.get("session_affinity_hits") or 0)
+        disagg["replica_session_affinity_hits"] = sess_hits
+        if ship_agg["out"] or ship_agg["in"]:
+            disagg["pool_ship"] = ship_agg
+        if (disagg["ships"] or disagg["ship_fallbacks"]
+                or disagg["session_affinity_hits"] or sess_hits
+                or any(r.role != ROLE_BOTH for r in replicas)):
+            fleet["disagg"] = disagg
         out = {"fleet": fleet, "replicas": entries, "retired": retired}
         supervisor = self.supervisor
         if supervisor is not None:
@@ -1129,6 +1632,15 @@ class _FleetHandler(ServingHTTPMixin, BaseHTTPRequestHandler):
             if not prompt:
                 self._json(400, {"error": "prompt_ids required"})
                 return
+            session_id = body.get("session_id")
+            if session_id is not None:
+                session_id = str(session_id)
+            if bool(body.get("stream", False)):
+                # SSE passthrough: relay the decode replica's event
+                # stream byte for byte (TTFT reaches the client through
+                # the fleet front exactly as it left the pool)
+                self._relay_stream(body, session_id)
+                return
             # forward the sampling mode too: silently downgrading a
             # top-k/top-p/beam request to greedy would answer 200 with
             # DIFFERENT generations than the single-server surface
@@ -1140,10 +1652,58 @@ class _FleetHandler(ServingHTTPMixin, BaseHTTPRequestHandler):
                 top_p=float(body.get("top_p", 1.0)),
                 beam_size=int(body.get("beam_size", 0)),
                 deadline_s=self._deadline_s(body),
-                request_id=self.request_id())
+                request_id=self.request_id(),
+                session_id=session_id)
             self._json(200, payload)
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
+
+    def _relay_stream(self, body, session_id) -> None:
+        """Relay one replica SSE stream through the fleet front.
+        Pre-stream failures (no routable replica, 4xx) still map to
+        proper statuses; once bytes flow, a replica death surfaces as a
+        truncated stream — tokens the client already has cannot be
+        un-sent, so there is no mid-stream failover."""
+        resp = self.router.open_lm_stream(
+            body.get("prompt_ids"), int(body.get("max_new_tokens", 32)),
+            temperature=float(body.get("temperature", 0.0)),
+            seed=int(body.get("seed", 0)) & 0x7FFFFFFF,
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+            beam_size=int(body.get("beam_size", 0)),
+            deadline_s=self._deadline_s(body),
+            request_id=self.request_id(), session_id=session_id)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            rid = getattr(self, "_request_id", None)
+            if rid is not None:
+                self.send_header("X-Request-Id", rid)
+            self.end_headers()
+            try:
+                while True:
+                    # read1: hand over whatever bytes are available —
+                    # a full read(n) would buffer events and destroy
+                    # the TTFT the stream exists to surface
+                    chunk = (resp.read1(512) if hasattr(resp, "read1")
+                             else resp.read(512))
+                    if not chunk:
+                        break
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+            except (http.client.HTTPException, OSError):
+                # client went away (BrokenPipe/reset) OR the replica
+                # read failed mid-stream (timeout, short read).  The
+                # SSE headers are already on the wire, so the ONLY
+                # valid move is to stop relaying — answering again
+                # would append a second HTTP response into the
+                # half-delivered event stream.  Closing resp (finally)
+                # propagates the disconnect to the replica, which
+                # abandons the lane.
+                pass
+        finally:
+            resp.close()
 
 
 class FleetServer:
@@ -1198,11 +1758,28 @@ class FleetServer:
                          "health sweeps", router.health_polls),
                         ("fleet_weights_version", "gauge",
                          "current rolling-swap weights version",
-                         router._version))
+                         router._version),
+                        ("fleet_ships_total", "counter",
+                         "KV page shipments routed prefill->decode",
+                         router.ships),
+                        ("fleet_ship_fallbacks_total", "counter",
+                         "shipments that fell back to local recompute",
+                         router.ship_fallbacks),
+                        ("fleet_session_spill_ships_total", "counter",
+                         "sticky-session spill-overs served by shipping",
+                         router.session_spill_ships),
+                        ("fleet_session_affinity_hits_total", "counter",
+                         "session requests routed to their previous "
+                         "replica", router.session_affinity_hits))
+            role_counts = dict(router._role_requests)
         from deeplearning4j_tpu.serving.metrics import _BREAKER_VALUES
 
         for name, kind, help, value in counters:
             yield (name, kind, help, {}, float(value))
+        for role, n in sorted(role_counts.items()):
+            yield ("fleet_role_requests_total", "counter",
+                   "successful dispatches by replica role",
+                   {"role": role}, float(n))
         for r in router.replicas():
             labels = {"replica": r.name}
             with r.lock:
@@ -1260,6 +1837,10 @@ __all__ = [
     "REPLICA_ACTIVE",
     "REPLICA_DRAINING",
     "REPLICA_STOPPED",
+    "ROLE_BOTH",
+    "ROLE_DECODE",
+    "ROLE_PREFILL",
+    "ROLES",
     "Replica",
     "check_fleet_ledger",
     "spawn_local_replica",

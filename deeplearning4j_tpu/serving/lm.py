@@ -71,6 +71,40 @@ Accounting: accept-rate / tokens-per-round counters in
 `ServingMetrics`, a `speculate` section in `stats()`, and
 drafted/accepted attrs on each request's decode trace span.
 
+**Disaggregated serving hooks** (ISSUE-14, `ship=True`, paged KV only):
+the pool speaks the KV page-shipping wire plane (`serving/transfer.py`)
+so a fleet can split worker roles — prefill workers chew long prompts
+and ship the finished pages to decode workers:
+
+- `prefill_export(...)` admits a request normally (radix reuse +
+  chunked prefill included), but at prefill completion — after the
+  first token is sampled and the prompt pages enter the radix tree —
+  the lane's pages are gathered OUT of the pool in one fixed-shape
+  dispatch (`parallel.generation.make_page_gather`) and the request
+  resolves to a `PageExport` instead of decoding further.  The radix
+  tree keeps the prefix, so repeated shared-prefix prefills stay
+  nearly free on the prefill worker.
+- `admit_with_pages(export)` allocates the lane's full page budget
+  from the local pool, installs the shipped pages in ONE batched
+  dispatch (`make_page_install`, the pending-install plane riding the
+  same pre-feed window as pending CoW copies), registers the prompt's
+  full pages in the local radix tree, and joins the lane mid-flight
+  exactly like a chunked-prefill completion: pos/fed/committed state
+  arrives with the shipment, decode continues through the normal step.
+  KV at position t is a pure function of tokens[0..t] and the weights,
+  so a shipped lane's output is byte-identical to a locally-prefilled
+  one, greedy or seeded sampling.
+
+**Token streaming + TTFT**: `generate_stream(...)` yields each
+committed token as it lands (speculative rounds can commit several at
+once — each is yielded individually), backing the SSE leg of
+`/lm/generate`; a consumer that goes away mid-stream abandons the
+request, freeing its slot and pages at the next admit round.  Every
+request stamps time-to-first-token into the `ttft` histogram — the
+latency the prefill/decode split exists to protect.  Per-request
+`session_id`s feed sticky-session accounting (`session_affinity_hits`)
+whether or not a fleet router is in front.
+
 Resilience contract (ISSUE-4, mirrors `batcher.MicroBatcher`): bounded
 admission (`max_queue_depth` -> `ServingOverloadError`), per-request
 deadlines shed at the admitter before a prompt ever occupies a slot
@@ -87,9 +121,10 @@ a zeroed pool would serve silent garbage.
 from __future__ import annotations
 
 import collections
+import queue as _queue
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -112,6 +147,11 @@ from deeplearning4j_tpu.serving.resilience import (
     DeadlineExceededError,
     ServingUnavailableError,
     check_admission,
+)
+from deeplearning4j_tpu.serving.transfer import (
+    PageExport,
+    check_compatible,
+    model_signature,
 )
 
 
@@ -143,7 +183,8 @@ class _LMRequest:
     __slots__ = ("prompt", "max_new", "temperature", "seed", "event",
                  "result", "error", "enqueued", "deadline", "abandoned",
                  "request_id", "t_installed", "t_done", "prefix_matched",
-                 "drafted", "accepted")
+                 "drafted", "accepted", "export", "export_result",
+                 "import_pages", "stream", "session_id", "t_first")
 
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
                  seed: int, deadline: Optional[float] = None,
@@ -164,6 +205,13 @@ class _LMRequest:
         self.prefix_matched = 0            # radix-cache tokens reused
         self.drafted = 0                   # speculative tokens proposed
         self.accepted = 0                  # speculative tokens accepted
+        # disaggregated serving (ISSUE-14)
+        self.export = False                # resolve at prefill completion
+        self.export_result: Optional[PageExport] = None
+        self.import_pages: Optional[PageExport] = None  # shipped-in lane
+        self.stream = None                 # per-token queue (SSE leg)
+        self.session_id: Optional[str] = None
+        self.t_first: Optional[float] = None  # first-committed-token stamp
 
 
 class _Slot:
@@ -205,7 +253,7 @@ class ContinuousLMServer:
                  kv: str = "paged", page_size: int = 16,
                  pages: Optional[int] = None, prefill_chunk: int = 8,
                  speculate: str = "off", draft_len: int = 4,
-                 drafter=None, draft_model=None,
+                 drafter=None, draft_model=None, ship: bool = False,
                  tracer: Optional[TraceRecorder] = None,
                  registry: Optional[MetricsRegistry] = None):
         if slots < 1:
@@ -235,6 +283,12 @@ class ContinuousLMServer:
                 f"(got kv={kv!r}): rollback rides the page tables")
         if speculate != "off" and draft_len < 1:
             raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+        if ship and kv != "paged":
+            # same typed-at-construction rule as speculate: shipping is
+            # page lists over the wire — the dense cache has none
+            raise ValueError(
+                f"ship=True requires kv='paged' (got kv={kv!r}): page "
+                f"shipping moves block-table pages")
         self.cfg = cfg
         self.params = params
         self.n_slots = int(slots)
@@ -289,6 +343,16 @@ class ContinuousLMServer:
         self._pool: Optional[PagePool] = None
         self._tree: Optional[RadixPrefixCache] = None
         self._pending_cow: List[Dict] = []
+        # disaggregation plane (ISSUE-14): page export/import programs,
+        # shipments awaiting their device install, and the sticky-session
+        # LRU (session_id -> last-seen tick) behind session_affinity_hits
+        self.ship = bool(ship)
+        self._gather = None
+        self._install = None
+        self._pending_install: List[Dict] = []
+        self._sessions: "collections.OrderedDict[str, int]" = (
+            collections.OrderedDict())
+        self._session_capacity = 1024
         self._warm_req: Optional[threading.Event] = None
         self._slots = [_Slot() for _ in range(self.n_slots)]
         self._steps = 0
@@ -320,19 +384,28 @@ class ContinuousLMServer:
         per_req = (lat.get("p50_ms", 100.0) or 100.0) / 1e3
         return max(0.1, per_req * (1 + len(self._queue) / self.n_slots))
 
-    def generate(self, prompt_ids, max_new_tokens: int,
-                 temperature: float = 0.0, seed: int = 0,
-                 timeout: Optional[float] = None,
-                 deadline_s: Optional[float] = None,
-                 request_id: Optional[str] = None) -> List[int]:
-        """prompt ids -> full sequence (prompt + generated), blocking.
-
-        `timeout` bounds the client's wait; `deadline_s` (default
-        `default_deadline_s`) rides the queue item so the admitter sheds
-        the request once it expires instead of spending decode steps on
-        a client that already gave up.  `request_id` names the request's
-        trace (``X-Request-Id``)."""
-        ids = self.validate(prompt_ids, max_new_tokens)
+    def _build_request(self, prompt_ids, max_new_tokens: int,
+                       temperature: float, seed: int,
+                       deadline_s: Optional[float],
+                       request_id: Optional[str],
+                       session_id: Optional[str] = None,
+                       export: bool = False) -> _LMRequest:
+        """Validate + construct one queue item — THE shared front half of
+        `generate`/`generate_stream`/`prefill_export`/`admit_with_pages`.
+        Export lanes are budgeted for their prefill pages only (they
+        never decode here); everything else pays the full page budget
+        via the ONE shared `validate()` contract."""
+        if export:
+            ids = validate_request(self.cfg, prompt_ids, max_new_tokens)
+            if (self.kv == "paged"
+                    and -(-len(ids) // self.page_size) > self.kv_pages):
+                raise ValueError(
+                    f"prompt needs {-(-len(ids) // self.page_size)} "
+                    f"prefill pages (page_size {self.page_size}) but "
+                    f"the pool holds {self.kv_pages}; raise -lm-pages "
+                    f"or shorten it")
+        else:
+            ids = self.validate(prompt_ids, max_new_tokens)
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         # fold into int32 range (the device-side PRNGKey seed dtype) so a
@@ -342,10 +415,18 @@ class ContinuousLMServer:
             deadline_s = self.default_deadline_s
         if request_id is None and self.tracer is not None:
             request_id = new_request_id()
-        req = _LMRequest(ids, int(max_new_tokens), temperature, seed,
-                         request_id=request_id)
+        req = _LMRequest(ids, int(max_new_tokens), float(temperature),
+                         seed, request_id=request_id)
         if deadline_s is not None:
             req.deadline = req.enqueued + float(deadline_s)
+        req.session_id = (str(session_id) if session_id is not None
+                          else None)
+        req.export = bool(export)
+        return req
+
+    def _enqueue(self, req: _LMRequest) -> None:
+        """Admission under the pool lock: the shared gate, worker start,
+        queue append, and sticky-session accounting."""
         with self._cond:
             check_admission(
                 accepting=self._accepting, breaker=self.breaker,
@@ -355,39 +436,66 @@ class ContinuousLMServer:
                 retry_after_s=self._retry_after_locked, what="LM")
             if not self._running:
                 self._start_locked()
+            if req.session_id is not None:
+                self._note_session_locked(req.session_id)
             self._queue.append(req)
             self.metrics.set_queue_depth(len(self._queue))
             self._cond.notify_all()
+
+    def _note_session_locked(self, session_id: str) -> None:
+        """Sticky-session accounting (ISSUE-14 satellite): a session_id
+        this pool has served before is an affinity HIT — the router's
+        session rendezvous (or a client pinning one replica) landed the
+        conversation back on the pool holding its radix pages.  Bounded
+        LRU; works identically behind a fleet front or a bare `serve`
+        so clients write one payload shape against both."""
+        hit = session_id in self._sessions
+        if hit:
+            self._sessions.move_to_end(session_id)
+        else:
+            self._sessions[session_id] = 1
+            while len(self._sessions) > self._session_capacity:
+                self._sessions.popitem(last=False)
+        self.metrics.record_session(hit)
+
+    def _cancel_request(self, req: _LMRequest, status: str) -> None:
+        """Give up on an unresolved request (client timeout or stream
+        disconnect).  Cancel rather than abandon (mirror of
+        MicroBatcher.submit): a still-queued request is removed so
+        retry-on-timeout clients cannot fill the pool with zombie
+        decodes; one already in a slot is MARKED abandoned and the
+        worker frees the slot at its next admit round (slot state is
+        written by the worker thread ONLY — freeing it here would race
+        the lock-free step-input build in `_drain_step`)."""
+        now = time.perf_counter()
+        with self._cond:
+            try:
+                self._queue.remove(req)
+                self.metrics.set_queue_depth(len(self._queue))
+                self.metrics.record_shed()
+            except ValueError:
+                req.abandoned = True
+                # a request the worker already RESOLVED needs no shed
+                # here: a completed result was counted as a served
+                # request at fold time, and a worker-shed error was
+                # counted when it was shed; an in-slot request is
+                # shed by the admitter when it frees the slot
+            resolved_with_error = (req.event.is_set()
+                                   and req.error is not None)
+        if (req.deadline is not None and now >= req.deadline
+                and not resolved_with_error):
+            # count a deadline miss only when the server-side
+            # deadline actually expired and the worker has not
+            # already accounted it (mirror of MicroBatcher.submit)
+            self.metrics.record_deadline_missed()
+        self._trace_request(req, time.perf_counter(), status)
+
+    def _wait(self, req: _LMRequest,
+              timeout: Optional[float]) -> List[int]:
+        """Block until the request resolves; raises its error or the
+        timeout as typed failures.  Returns `req.result`."""
         if not req.event.wait(timeout):
-            # Cancel rather than abandon (mirror of MicroBatcher.submit):
-            # a still-queued request is removed so retry-on-timeout
-            # clients cannot fill the pool with zombie decodes; one
-            # already in a slot is MARKED abandoned and the worker frees
-            # the slot at its next admit round (slot state is written by
-            # the worker thread ONLY — freeing it here would race the
-            # lock-free step-input build in `_drain_step`).
-            now = time.perf_counter()
-            with self._cond:
-                try:
-                    self._queue.remove(req)
-                    self.metrics.set_queue_depth(len(self._queue))
-                    self.metrics.record_shed()
-                except ValueError:
-                    req.abandoned = True
-                    # a request the worker already RESOLVED needs no shed
-                    # here: a completed result was counted as a served
-                    # request at fold time, and a worker-shed error was
-                    # counted when it was shed; an in-slot request is
-                    # shed by the admitter when it frees the slot
-                resolved_with_error = (req.event.is_set()
-                                       and req.error is not None)
-            if (req.deadline is not None and now >= req.deadline
-                    and not resolved_with_error):
-                # count a deadline miss only when the server-side
-                # deadline actually expired and the worker has not
-                # already accounted it (mirror of MicroBatcher.submit)
-                self.metrics.record_deadline_missed()
-            self._trace_request(req, time.perf_counter(), "timeout")
+            self._cancel_request(req, "timeout")
             raise DeadlineExceededError(
                 f"LM request timed out after {timeout}s")
         done = time.perf_counter()
@@ -396,6 +504,161 @@ class ContinuousLMServer:
             raise req.error
         self._trace_request(req, done, "ok")
         return req.result
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0, seed: int = 0,
+                 timeout: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 request_id: Optional[str] = None,
+                 session_id: Optional[str] = None) -> List[int]:
+        """prompt ids -> full sequence (prompt + generated), blocking.
+
+        `timeout` bounds the client's wait; `deadline_s` (default
+        `default_deadline_s`) rides the queue item so the admitter sheds
+        the request once it expires instead of spending decode steps on
+        a client that already gave up.  `request_id` names the request's
+        trace (``X-Request-Id``); `session_id` feeds sticky-session
+        affinity accounting."""
+        req = self._build_request(prompt_ids, max_new_tokens, temperature,
+                                  seed, deadline_s, request_id,
+                                  session_id=session_id)
+        self._enqueue(req)
+        return self._wait(req, timeout)
+
+    def generate_stream(self, prompt_ids, max_new_tokens: int,
+                        temperature: float = 0.0, seed: int = 0,
+                        timeout: Optional[float] = None,
+                        deadline_s: Optional[float] = None,
+                        request_id: Optional[str] = None,
+                        session_id: Optional[str] = None
+                        ) -> Iterator[int]:
+        """Streaming `generate`: admission happens HERE (typed errors
+        raise before a single byte of response is committed), then the
+        returned iterator yields each committed token as the worker
+        folds it — a speculative round's multi-token commit is yielded
+        token by token.  Closing the iterator mid-stream (the SSE
+        client disconnected) abandons the request so its slot and pages
+        free at the worker's next admit round instead of decoding for
+        nobody.  The full sequence is `prompt + every yielded token`."""
+        req = self._build_request(prompt_ids, max_new_tokens, temperature,
+                                  seed, deadline_s, request_id,
+                                  session_id=session_id)
+        req.stream = _queue.SimpleQueue()
+        self._enqueue(req)
+        return self._stream_tokens(req, timeout)
+
+    def _stream_tokens(self, req: _LMRequest,
+                       timeout: Optional[float]) -> Iterator[int]:
+        deadline = (None if timeout is None
+                    else time.perf_counter() + float(timeout))
+        cancelled = False
+        try:
+            while True:
+                wait = 0.05
+                if deadline is not None:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        cancelled = True
+                        self._cancel_request(req, "timeout")
+                        raise DeadlineExceededError(
+                            f"LM stream timed out after {timeout}s")
+                    wait = min(wait, remaining)
+                try:
+                    yield int(req.stream.get(timeout=wait))
+                    continue
+                except _queue.Empty:
+                    pass
+                if req.event.is_set():
+                    # the worker resolved the request; tokens are pushed
+                    # BEFORE the event is set (same thread), so one final
+                    # drain empties the queue in order
+                    while True:
+                        try:
+                            yield int(req.stream.get_nowait())
+                        except _queue.Empty:
+                            break
+                    if req.error is not None:
+                        self._trace_request(req, time.perf_counter(),
+                                            "error")
+                        raise req.error
+                    self._trace_request(req, time.perf_counter(), "ok")
+                    return
+        finally:
+            if not cancelled and not req.event.is_set():
+                # consumer went away mid-stream (GeneratorExit from the
+                # SSE handler, or an error in the client loop): abandon
+                # so the slot and its pages stop decoding for nobody.
+                # The timeout branch above already cancelled — a second
+                # cancel would double-count the deadline miss and
+                # record two traces for one request.
+                self._cancel_request(req, "disconnect")
+
+    # ---- disaggregation: KV page export / import (ISSUE-14) ---------------
+
+    def _require_ship(self, what: str) -> None:
+        if self.kv != "paged":
+            raise ValueError(
+                f"page {what} requires kv='paged': shipping moves "
+                f"block-table pages (got kv={self.kv!r})")
+        if not self.ship:
+            raise ValueError(
+                f"page {what} requested but the pool was started with "
+                f"ship=False (serve with -lm-ship, or "
+                f"ContinuousLMServer(ship=True))")
+
+    def prefill_export(self, prompt_ids, max_new_tokens: int,
+                       temperature: float = 0.0, seed: int = 0,
+                       timeout: Optional[float] = None,
+                       deadline_s: Optional[float] = None,
+                       request_id: Optional[str] = None,
+                       session_id: Optional[str] = None) -> PageExport:
+        """Prefill-worker half of disaggregation: run the prompt through
+        normal admission (radix reuse, chunked prefill, CoW) but resolve
+        at prefill completion with the lane's shippable state — prompt
+        pages, block-table metadata, and the FIRST committed token (the
+        last prompt token's logits produce it, so shipping without it
+        would cost the decode worker a redundant dispatch).  The request
+        contract (max_new within max_len etc.) is validated here so a
+        doomed request fails on the prefill worker, before any bytes
+        move."""
+        self._require_ship("export")
+        req = self._build_request(prompt_ids, max_new_tokens, temperature,
+                                  seed, deadline_s, request_id,
+                                  session_id=session_id, export=True)
+        self._enqueue(req)
+        self._wait(req, timeout)
+        return req.export_result
+
+    def admit_with_pages(self, export: PageExport,
+                         timeout: Optional[float] = None,
+                         deadline_s: Optional[float] = None,
+                         request_id: Optional[str] = None) -> List[int]:
+        """Decode-worker half: verify the shipment's geometry against
+        this pool (`PageShipError` on any mismatch — the caller's
+        recompute ladder), allocate the lane's full page budget, install
+        the shipped pages in one batched dispatch, and join mid-flight
+        exactly like a chunked-prefill completion.  Returns the full
+        sequence, byte-identical to a locally-prefilled lane."""
+        self._require_ship("import")
+        check_compatible(export, self.cfg, self.page_size)
+        if len(export.committed) >= export.max_new:
+            # the prefill worker's first sample already filled the whole
+            # budget (max_new == 1): nothing to decode — answer without
+            # occupying a slot or installing a page
+            with self._cond:
+                if export.session_id is not None:
+                    self._note_session_locked(export.session_id)
+            self.metrics.record_request(0.0)
+            self.metrics.record_first_token(0.0)
+            return (list(export.prompt)
+                    + list(export.committed[:export.max_new]))
+        req = self._build_request(export.prompt, export.max_new,
+                                  export.temperature, export.seed,
+                                  deadline_s, request_id,
+                                  session_id=export.session_id)
+        req.import_pages = export
+        self._enqueue(req)
+        return self._wait(req, timeout)
 
     def _trace_request(self, req: _LMRequest, done: float,
                        status: str) -> None:
@@ -497,10 +760,25 @@ class ContinuousLMServer:
         with compile_scope("lm:page_copy"):
             k, v = self._copy(*self._cache, np.int32(0), np.int32(0))
         self._cache = (k, v)
+        if self.ship:
+            # the shipping pair: a gather out of the live pool (not
+            # donated — the row of nulls reads only the null page) and
+            # an n=0 install whose every row lands on the null page
+            zrow = np.zeros((self.max_pages,), np.int32)
+            with compile_scope("lm:page_gather"):
+                self._gather(*self._cache, zrow)
+            shape = (self.cfg.n_layers, self.max_pages, self.page_size,
+                     self.cfg.n_heads, self.cfg.head_dim)
+            zp = np.zeros(shape, np.dtype(self.cfg.dtype))
+            with compile_scope("lm:page_install"):
+                k, v = self._install(*self._cache, zp, zp, zrow,
+                                     np.int32(0))
+            self._cache = (k, v)
 
     def compiled_programs(self) -> int:
         if self.kv == "dense":
             return 1
+        ship = 2 if self.ship else 0   # page gather + batched install
         if self.speculate != "off":
             # 1-wide decode + the shared prefill/verify wide program +
             # page copy, plus whatever the drafter runs on device
@@ -508,8 +786,8 @@ class ContinuousLMServer:
                        if self._drafter is not None
                        and hasattr(self._drafter, "compiled_programs")
                        else 0)
-            return 3 + drafter
-        return 2 + (1 if self.prefill_chunk > 1 else 0)
+            return 3 + drafter + ship
+        return 2 + (1 if self.prefill_chunk > 1 else 0) + ship
 
     def stop(self) -> None:
         with self._cond:
@@ -610,7 +888,10 @@ class ContinuousLMServer:
                                    if self._pool is not None
                                    else self.kv_pages),
                     "radix_nodes": (self._tree.nodes
-                                    if self._tree is not None else 0)})
+                                    if self._tree is not None else 0),
+                    "ship": self.ship})
+            if self._sessions:
+                out["sessions_tracked"] = len(self._sessions)
             out["kv"] = kv
             if self.speculate != "off":
                 spec = {"mode": self.speculate,
@@ -670,6 +951,10 @@ class ContinuousLMServer:
         self._pool = PagePool(self.kv_pages + 1, self.page_size)
         self._tree = RadixPrefixCache(self._pool)
         self._pending_cow = []
+        # shipments awaiting device install referenced pages (and
+        # content) that died with the pool — their lanes restart or fail
+        # with it, so the pending plane resets wholesale too
+        self._pending_install = []
         for s in self._slots:
             s.table = None
             s.owned = []
@@ -713,6 +998,16 @@ class ContinuousLMServer:
                         if self.prefill_chunk > 1 else None)
                 self._copy = make_page_copy(self.cfg, total,
                                             self.page_size)
+                if self.ship:
+                    from deeplearning4j_tpu.parallel.generation import (
+                        make_page_gather,
+                        make_page_install,
+                    )
+
+                    self._gather = make_page_gather(self.cfg, total,
+                                                    self.page_size)
+                    self._install = make_page_install(self.cfg, total,
+                                                      self.page_size)
                 if self.speculate != "off" and self._drafter is None:
                     from deeplearning4j_tpu.serving.draft import (
                         make_drafter,
@@ -781,7 +1076,37 @@ class ContinuousLMServer:
         supply the fresh pages — the request stays queued, FIFO.  Every
         page the plan references is already retained."""
         plen = len(req.prompt)
-        total_pages = self._required_pages(plen, req.max_new)
+        if req.import_pages is not None:
+            # shipped-in lane (ISSUE-14): FULL prefix pages this pool's
+            # radix tree already holds are reused instead of installing
+            # duplicate shipped copies — a sticky session's next turn
+            # re-ships its growing prompt, and without this the decode
+            # pool would pay O(turns x prompt) duplicate pages for a
+            # prefix it already caches.  No plen-1 cap (unlike normal
+            # admission): the first token arrived committed, nothing
+            # re-feeds.  Partial (CoW) matches are skipped — the
+            # shipped copy of a mid-page divergence is cheaper than a
+            # device copy + overwrite.
+            total_pages = self._required_pages(plen, req.max_new)
+            full, partial = self._tree.match(req.prompt)
+            if partial is not None:
+                self._pool.release([partial[0]])
+            need = total_pages - len(full)
+            if self._pool.free < need:
+                if self._pool.free + self._tree.evictable() >= need:
+                    self._tree.evict(need)
+            fresh = self._pool.alloc(need)
+            if fresh is None:
+                if full:
+                    self._pool.release(full)
+                return None
+            return {"full": full, "partial": None, "fresh": fresh,
+                    "matched": len(full) * self.page_size,
+                    "total_pages": total_pages}
+        # export lanes (prefill-only) budget just their prompt pages —
+        # decode happens on whatever pool the shipment lands in
+        total_pages = (-(-plen // self.page_size) if req.export
+                       else self._required_pages(plen, req.max_new))
         # cap reuse at plen-1: the LAST prompt token is always re-fed —
         # its logits are what the first sampled token comes from
         full, partial = self._tree.match(req.prompt[:plen - 1])
@@ -828,6 +1153,51 @@ class ContinuousLMServer:
         row[:n_full] = plan["full"]
         row[n_full:plan["total_pages"]] = plan["fresh"]
         slot.table = row
+        if req.import_pages is not None:
+            # shipped-in lane: arrive mid-flight exactly where the
+            # prefill worker left it — prompt fully fed, first token(s)
+            # committed, next write lands at pos (possibly mid-page,
+            # overwriting shipped garbage past the divergence).  The
+            # device install rides the pending plane below, executed
+            # BEFORE any feed of this round; the prompt's full pages
+            # enter the local radix tree now so the next shared-prefix
+            # admission (this session's next turn) reuses them.
+            ex = req.import_pages
+            slot.fed = len(req.prompt)
+            slot.pos = int(ex.pos)
+            slot.generated = list(ex.committed)
+            n_ship = ex.n_pages
+            mp = self.max_pages
+            shape = (self.cfg.n_layers, mp, self.page_size,
+                     self.cfg.n_heads, self.cfg.head_dim)
+            pk = np.zeros(shape, np.dtype(self.cfg.dtype))
+            pv = np.zeros(shape, np.dtype(self.cfg.dtype))
+            pk[:, :n_ship] = ex.pages_k
+            pv[:, :n_ship] = ex.pages_v
+            # radix-matched prefix pages are NOT re-installed: their
+            # rows in the install target the null page, so the shared
+            # pages (other lanes may be reading them) are never
+            # rewritten — shipped content for them is byte-identical
+            # by the radix invariant anyway
+            irow = row.copy()
+            irow[:len(plan["full"])] = 0
+            self._pending_install.append(
+                {"pk": pk, "pv": pv, "row": irow,
+                 "n": n_ship, "nbytes": ex.nbytes()})
+            self.metrics.record_prefix_query(plan["matched"])
+            n_full_prompt = len(req.prompt) // self.page_size
+            if n_full_prompt:
+                slot.inserted = True
+                self._tree.insert(
+                    req.prompt[:n_full_prompt * self.page_size],
+                    [int(p) for p in row[:n_full_prompt]])
+            # the shipment's committed tokens ARE this lane's first
+            # tokens: stamp TTFT at install (the prefill worker already
+            # paid the first-token latency; this pool's number says how
+            # long the shipment sat in its queue)
+            req.t_first = req.t_installed
+            self.metrics.record_first_token(req.t_first - req.enqueued)
+            return
         if plan["partial"] is not None:
             # copy-on-write: the divergence page's matched tokens are
             # valid KV; copy it into this lane's first fresh page and
@@ -955,6 +1325,7 @@ class ContinuousLMServer:
             if not active:
                 return False
             cow, self._pending_cow = self._pending_cow, []
+            installs, self._pending_install = self._pending_install, []
         if self.breaker is not None and not self.breaker.allow_dispatch():
             # open breaker: fast-fail whatever is in flight rather than
             # burning decode steps on a failing device
@@ -982,7 +1353,7 @@ class ContinuousLMServer:
             # fault handler — slots restart at pos 0, nothing to keep)
             self._reset_cache()
         if self.kv == "paged":
-            return self._dispatch_paged(active, cow)
+            return self._dispatch_paged(active, cow, installs)
         return self._dispatch_dense(active)
 
     def _dispatch_dense(self, active) -> bool:
@@ -1021,7 +1392,7 @@ class ContinuousLMServer:
                 # the LAST prompt token's logits yield the first sample
                 if slot.fed < len(slot.req.prompt):
                     continue
-            slot.generated.append(int(nxt[i]))
+            self._commit_tokens(slot, [int(nxt[i])])
             emitted += 1
             if len(slot.generated) >= slot.req.max_new:
                 self._finish_slot(slot)
@@ -1067,9 +1438,60 @@ class ContinuousLMServer:
                 out[i] = clean
         return out
 
-    def _dispatch_paged(self, active, cow) -> bool:
-        # land pending copy-on-write pages first: the divergence page's
-        # matched prefix must be resident before its lane's first feed
+    def _commit_tokens(self, slot: _Slot, toks: List[int]) -> None:
+        """Fold newly committed tokens into a lane: first-token TTFT
+        stamp, the lane's generated list, and the request's stream (one
+        push per token — a speculative round's multi-token commit
+        streams as individual events)."""
+        req = slot.req
+        if req.t_first is None:
+            req.t_first = time.perf_counter()
+            self.metrics.record_first_token(req.t_first - req.enqueued)
+        slot.generated.extend(toks)
+        if req.stream is not None and not req.abandoned:
+            for t in toks:
+                req.stream.put(int(t))
+
+    def _export_slot(self, slot: _Slot) -> None:
+        """Prefill just completed on an export lane: gather its pages
+        out of the pool (one fixed-shape dispatch + one host sync),
+        resolve the request with the shipment, and free the lane.  Runs
+        BEFORE the lane's pages are released — the radix tree keeps the
+        prompt pages for the next shared-prefix prefill, and page
+        content is only ever recycled through the allocator."""
+        req = slot.req
+        t0 = time.perf_counter()
+        with compile_scope("lm:page_gather"):
+            pk, pv = self._gather(*self._cache, slot.table)
+        n = -(-slot.pos // self.page_size)
+        pk = np.asarray(pk)[:, :n]
+        pv = np.asarray(pv)[:, :n]
+        ex = PageExport(
+            prompt=list(req.prompt), max_new=req.max_new,
+            temperature=req.temperature, seed=req.seed,
+            committed=list(slot.generated), pos=int(slot.pos),
+            page_size=self.page_size, pages_k=pk, pages_v=pv,
+            model=model_signature(self.cfg, self.page_size),
+            session_id=req.session_id)
+        self.metrics.record_ship("out", n, ex.nbytes(),
+                                 time.perf_counter() - t0)
+        req.export_result = ex
+        self._finish_slot(slot)
+
+    def _dispatch_paged(self, active, cow, installs) -> bool:
+        # land shipped-in pages first (their lane's committed state is
+        # already live — its next feed reads them), then pending
+        # copy-on-write pages: a CoW admitted in the same round may
+        # diverge FROM a page the shipment just installed
+        for item in installs:
+            t0 = time.perf_counter()
+            with compile_scope("lm:page_install"):
+                k, v = self._install(*self._cache, item["pk"],
+                                     item["pv"], item["row"],
+                                     np.int32(item["n"]))
+            self._cache = (k, v)
+            self.metrics.record_ship("in", item["n"], item["nbytes"],
+                                     time.perf_counter() - t0)
         for item in cow:
             with compile_scope("lm:page_copy"):
                 k, v = self._copy(*self._cache, np.int32(item["src"]),
@@ -1155,8 +1577,13 @@ class ContinuousLMServer:
                 # prefill complete: its full pages become reusable, and
                 # the last prompt token's logits yield the first sample
                 self._insert_prompt_pages(slot)
-                slot.generated.append(int(nxt[i]))
+                self._commit_tokens(slot, [int(nxt[i])])
                 emitted += 1
+                if slot.req.export:
+                    # export lane: this pool's job ends at prefill —
+                    # gather the pages, resolve with the shipment
+                    self._export_slot(slot)
+                    continue
             else:
                 # decode fold with in-jit accept/rollback: commit the
                 # accepted draft prefix plus the bonus token; rewind is
@@ -1170,10 +1597,13 @@ class ContinuousLMServer:
                 k_drafted = int(n_draft[i])
                 slot.pos += 1 + a
                 if k_drafted:
-                    slot.generated.extend(drafts[i][:a])
                     slot.req.drafted += k_drafted
                     slot.req.accepted += a
-                slot.generated.append(int(nxt[i]))
+                    self._commit_tokens(
+                        slot, [int(t) for t in drafts[i][:a]]
+                        + [int(nxt[i])])
+                else:
+                    self._commit_tokens(slot, [int(nxt[i])])
                 emitted += 1 + a
                 self.metrics.record_decode_round(
                     1 + a, drafted=k_drafted, accepted=a)

@@ -119,6 +119,35 @@ class ServingMetrics:
         self.spec_accepted_total = Counter(
             "serving_spec_accepted_total",
             "draft tokens the target model accepted")
+        # disaggregated-serving ledger (ISSUE-14): KV page shipping in
+        # and out of this pool, time-to-first-token, and sticky-session
+        # affinity — the numbers that say whether the prefill/decode
+        # split and the session routing are paying for themselves
+        self.ships_out_total = Counter(
+            "serving_kv_ships_out_total",
+            "lanes exported as KV page shipments")
+        self.ships_in_total = Counter(
+            "serving_kv_ships_in_total",
+            "lanes admitted from KV page shipments")
+        self.pages_shipped_total = Counter(
+            "serving_kv_pages_shipped_total",
+            "KV pages moved through shipments (both directions)")
+        self.ship_bytes_total = Counter(
+            "serving_kv_ship_bytes_total",
+            "KV page payload bytes moved through shipments")
+        self.ship_hist = Histogram(
+            "serving_kv_ship_seconds",
+            "device-side gather/install time per shipment")
+        self.ttft_hist = Histogram(
+            "serving_lm_ttft_seconds",
+            "admission to first committed token")
+        self.session_queries_total = Counter(
+            "serving_session_queries_total",
+            "LM requests that carried a session_id")
+        self.session_affinity_hits_total = Counter(
+            "serving_session_affinity_hits_total",
+            "session_id requests that landed on a pool that had "
+            "already served the session")
         # latency: end-to-end histogram + the queue-wait vs
         # dispatch-compute split (ISSUE-8 satellite — the batcher knows
         # both timestamps; before this they were collapsed into one
@@ -155,6 +184,11 @@ class ServingMetrics:
                   self.decode_rounds_total, self.decode_tokens_total,
                   self.spec_rounds_total, self.spec_drafted_total,
                   self.spec_accepted_total,
+                  self.ships_out_total, self.ships_in_total,
+                  self.pages_shipped_total, self.ship_bytes_total,
+                  self.ship_hist, self.ttft_hist,
+                  self.session_queries_total,
+                  self.session_affinity_hits_total,
                   self.latency_hist, self.queue_wait_hist,
                   self.compute_hist):
             registry.register(m, **labels)
@@ -237,6 +271,32 @@ class ServingMetrics:
             self.spec_rounds_total.inc()
             self.spec_drafted_total.inc(int(drafted))
             self.spec_accepted_total.inc(int(accepted))
+
+    def record_ship(self, direction: str, pages: int, nbytes: int,
+                    seconds: float) -> None:
+        """One KV page shipment through this pool: `direction` is
+        "out" (a lane exported at prefill completion) or "in" (a lane
+        admitted from shipped pages); `seconds` is the device-side
+        gather/install cost, the wire hop belongs to the router."""
+        self._touch()
+        (self.ships_out_total if direction == "out"
+         else self.ships_in_total).inc()
+        self.pages_shipped_total.inc(int(pages))
+        self.ship_bytes_total.inc(int(nbytes))
+        self.ship_hist.observe(max(0.0, float(seconds)))
+
+    def record_first_token(self, seconds: float) -> None:
+        """Time-to-first-token for one request: admission to the first
+        committed token (the disagg bench's first-class column)."""
+        self.ttft_hist.observe(max(0.0, float(seconds)))
+
+    def record_session(self, hit: bool) -> None:
+        """One session_id-carrying request; `hit` when this pool had
+        already served the session (sticky affinity worked)."""
+        self._touch()
+        self.session_queries_total.inc()
+        if hit:
+            self.session_affinity_hits_total.inc()
 
     def record_prefix_query(self, tokens_saved: int) -> None:
         """One LM admission's radix-cache outcome: `tokens_saved` prompt
@@ -321,6 +381,24 @@ class ServingMetrics:
             out["spec_accepted"] = int(self.spec_accepted_total.value)
             out["spec_accept_rate"] = round(
                 out["spec_accepted"] / drafted, 3)
+        ttft = _ms(self.ttft_hist.summary())
+        if ttft["count"]:
+            out["ttft"] = ttft
+        ships = (int(self.ships_out_total.value)
+                 + int(self.ships_in_total.value))
+        if ships:
+            out["ship"] = {
+                "out": int(self.ships_out_total.value),
+                "in": int(self.ships_in_total.value),
+                "pages_shipped": int(self.pages_shipped_total.value),
+                "ship_bytes": int(self.ship_bytes_total.value),
+                **{k: v for k, v in
+                   _ms(self.ship_hist.summary()).items() if k != "count"}}
+        sq = int(self.session_queries_total.value)
+        if sq:
+            out["session_queries"] = sq
+            out["session_affinity_hits"] = int(
+                self.session_affinity_hits_total.value)
         if pq:
             out["prefix_queries"] = pq
             out["prefix_hits"] = int(self.prefix_hits_total.value)

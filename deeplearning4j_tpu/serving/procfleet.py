@@ -238,6 +238,10 @@ class WorkerSpec:
     url: str
     command: Optional[List[str]] = None
     log_path: Optional[str] = None
+    # disaggregated role (ISSUE-14): routing policy the supervisor
+    # stamps onto every incarnation's Replica — a resurrected prefill
+    # worker comes back AS a prefill worker
+    role: str = "both"
 
     def host_port(self):
         parsed = urllib.parse.urlparse(self.url)
@@ -353,7 +357,9 @@ class FleetSupervisor:
             out.append(self.manage(WorkerSpec(
                 name=f"worker-{i}", url=launcher.url(i),
                 command=launcher.command(i),
-                log_path=str(log_path) if log_path is not None else None)))
+                log_path=str(log_path) if log_path is not None else None,
+                role=(launcher.role(i) if hasattr(launcher, "role")
+                      else "both"))))
         return out
 
     def release(self, name: str) -> SupervisedWorker:
@@ -441,7 +447,8 @@ class FleetSupervisor:
             # not inherit the corpse's exclusion entry
             name = (worker.name if worker.attaches == 0
                     else f"{worker.name}#{worker.attaches}")
-            replica = Replica(name, worker.spec.url, process=worker.proc)
+            replica = Replica(name, worker.spec.url, process=worker.proc,
+                              role=worker.spec.role)
             worker.replica = replica
             worker.state = WORKER_READY
             worker.probe_failures = 0

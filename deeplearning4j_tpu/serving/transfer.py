@@ -1,0 +1,234 @@
+"""KV page shipping: the disaggregated-serving wire plane (ISSUE-14).
+
+Disaggregated prefill/decode serving splits one request across two
+worker processes: a PREFILL worker chews the prompt chunk-by-chunk
+(compute-bound, bursty) and a DECODE worker runs the token loop
+(latency-bound, steady).  The state that has to cross the wire between
+them is the lane's finished KV pages — the same gather/re-split
+redistribution discipline the elastic checkpoint plane proved for
+optimizer state (`parallel/partition.py`, arXiv 2112.01075), applied
+live between serving processes at page granularity.
+
+This module owns the WIRE FORMAT only; it is deliberately import-light
+(numpy + stdlib, no jax) so both HTTP fronts can parse and verify a
+shipment without touching a device:
+
+- `PageExport` — everything a decode worker needs to continue a lane
+  exactly where the prefill worker left it: the request contract
+  (prompt/max_new/temperature/seed), the committed tokens so far (the
+  prefill worker samples the FIRST token — the last prompt token's
+  logits produce it, so shipping without it would redo a dispatch), the
+  next cache position, and the page stacks `[L, n_pages, ps, H, K]` for
+  k and v.
+- `serialize_export` / `deserialize_export` — one binary frame: magic,
+  length-prefixed JSON header, raw page payload.  The header carries
+  the SHA-256 of the payload (checked like checkpoint shards) plus the
+  `model_signature` of the exporting pool, so a flipped byte on the
+  wire or a mismatched deployment becomes a typed `PageShipError` the
+  router answers by RECOMPUTING locally — never silent garbage KV.
+- `check_compatible` — the import gate: layer/head/dtype/page-size
+  geometry must match bit-for-bit or the pages mean nothing to the
+  importing pool.
+
+Sharing is sound for the same reason the radix cache is: KV at
+position t is a deterministic function of tokens[0..t] and the
+weights, so an installed page holds byte-identical k/v to what the
+decode worker would have computed itself — shipped-lane output is
+byte-identical to a locally-prefilled lane, greedy or seeded sampling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# frame magic + format version: bump WIRE_VERSION on any header/payload
+# layout change so a mixed-version fleet fails typed, not misparsed
+MAGIC = b"DL4JKVS\x01"
+WIRE_VERSION = 1
+
+# header fields every frame must carry (missing = typed, not KeyError)
+_REQUIRED = ("version", "prompt", "max_new", "temperature", "seed",
+             "committed", "pos", "page_size", "n_pages", "dtype",
+             "shape", "sha256", "model")
+
+
+class PageShipError(RuntimeError):
+    """A KV page shipment could not be accepted: truncated/misframed
+    bytes, a failed SHA-256 integrity check, or geometry incompatible
+    with the importing pool.  The failure ladder is RECOMPUTE, never
+    trust: the router falls back to a local prefill on the decode
+    worker (docs/robustness.md "Disaggregated serving")."""
+
+
+def model_signature(cfg, page_size: int) -> Dict:
+    """The geometry a shipped page stack is only meaningful under.
+    `max_len`/`vocab_size` ride along for request re-validation on the
+    importing side; the KV-shape fields are the hard compatibility
+    gate."""
+    return {"n_layers": int(cfg.n_layers), "n_heads": int(cfg.n_heads),
+            "head_dim": int(cfg.head_dim), "dtype": str(cfg.dtype),
+            "max_len": int(cfg.max_len),
+            "vocab_size": int(cfg.vocab_size),
+            "page_size": int(page_size)}
+
+
+@dataclasses.dataclass
+class PageExport:
+    """One lane's shippable state at prefill completion."""
+
+    prompt: List[int]
+    max_new: int
+    temperature: float
+    seed: int
+    committed: List[int]        # tokens generated so far (>= 1)
+    pos: int                    # next cache position (== len(prompt))
+    page_size: int
+    pages_k: np.ndarray         # [L, n_pages, ps, H, K]
+    pages_v: np.ndarray
+    model: Dict                 # model_signature of the exporting pool
+    session_id: Optional[str] = None
+
+    @property
+    def n_pages(self) -> int:
+        return int(self.pages_k.shape[1])
+
+    def nbytes(self) -> int:
+        return int(self.pages_k.nbytes + self.pages_v.nbytes)
+
+
+def serialize_export(ex: PageExport) -> bytes:
+    """PageExport -> one wire frame: MAGIC + u32 header length + JSON
+    header + raw page payload (k then v, C-order).  The header's sha256
+    covers the payload bytes exactly as framed."""
+    pk = np.ascontiguousarray(ex.pages_k)
+    pv = np.ascontiguousarray(ex.pages_v)
+    if pk.shape != pv.shape:
+        raise ValueError(f"pages_k {pk.shape} != pages_v {pv.shape}")
+    payload = pk.tobytes() + pv.tobytes()
+    header = {
+        "version": WIRE_VERSION,
+        "prompt": [int(t) for t in ex.prompt],
+        "max_new": int(ex.max_new),
+        "temperature": float(ex.temperature),
+        "seed": int(ex.seed),
+        "committed": [int(t) for t in ex.committed],
+        "pos": int(ex.pos),
+        "page_size": int(ex.page_size),
+        "n_pages": int(pk.shape[1]),
+        "dtype": str(pk.dtype),
+        "shape": list(pk.shape),
+        "sha256": hashlib.sha256(payload).hexdigest(),
+        "model": dict(ex.model),
+    }
+    if ex.session_id is not None:
+        header["session_id"] = str(ex.session_id)
+    hj = json.dumps(header).encode()
+    return MAGIC + struct.pack(">I", len(hj)) + hj + payload
+
+
+def deserialize_export(data: bytes) -> PageExport:
+    """One wire frame -> PageExport, integrity-verified.  EVERY malformed
+    input — wrong magic, truncated header or payload, non-JSON header,
+    missing fields, shape/byte-count mismatch, failed SHA-256 — raises
+    `PageShipError` naming what broke, so the import path has exactly
+    one failure type to map to its recompute ladder."""
+    pre = len(MAGIC) + 4
+    if len(data) < pre or data[:len(MAGIC)] != MAGIC:
+        raise PageShipError(
+            f"not a KV page shipment: bad magic/short frame "
+            f"({len(data)} bytes)")
+    (hlen,) = struct.unpack(">I", data[len(MAGIC):pre])
+    if len(data) < pre + hlen:
+        raise PageShipError(
+            f"truncated shipment header ({len(data)} bytes, header "
+            f"needs {pre + hlen})")
+    try:
+        header = json.loads(data[pre:pre + hlen])
+    except ValueError as e:
+        raise PageShipError(f"shipment header is not JSON: {e}") from e
+    missing = [k for k in _REQUIRED if k not in header]
+    if missing:
+        raise PageShipError(f"shipment header missing {missing}")
+    if int(header["version"]) != WIRE_VERSION:
+        raise PageShipError(
+            f"shipment wire version {header['version']} != "
+            f"{WIRE_VERSION}")
+    payload = data[pre + hlen:]
+    digest = hashlib.sha256(payload).hexdigest()
+    if digest != header["sha256"]:
+        raise PageShipError(
+            f"shipment integrity check failed: sha256 {digest[:12]}… != "
+            f"header {str(header['sha256'])[:12]}…")
+    shape = tuple(int(d) for d in header["shape"])
+    try:
+        dt = np.dtype(header["dtype"])
+    except TypeError as e:
+        raise PageShipError(
+            f"shipment dtype {header['dtype']!r} unknown") from e
+    want = 2 * int(np.prod(shape)) * dt.itemsize
+    if len(payload) != want:
+        raise PageShipError(
+            f"shipment payload {len(payload)} bytes != {want} for "
+            f"2 x {shape} {dt}")
+    half = want // 2
+    pk = np.frombuffer(payload[:half], dt).reshape(shape)
+    pv = np.frombuffer(payload[half:], dt).reshape(shape)
+    return PageExport(
+        prompt=[int(t) for t in header["prompt"]],
+        max_new=int(header["max_new"]),
+        temperature=float(header["temperature"]),
+        seed=int(header["seed"]),
+        committed=[int(t) for t in header["committed"]],
+        pos=int(header["pos"]),
+        page_size=int(header["page_size"]),
+        pages_k=pk, pages_v=pv, model=dict(header["model"]),
+        session_id=header.get("session_id"))
+
+
+def check_compatible(ex: PageExport, cfg, page_size: int) -> None:
+    """The import gate: shipped geometry must equal the importing
+    pool's, field for field — a page stack cut for different
+    layers/heads/dtype/page-size would install as silent garbage.
+    Raises `PageShipError` naming every mismatched field."""
+    local = model_signature(cfg, page_size)
+    bad = [f"{k}: shipped {ex.model.get(k)!r} != local {v!r}"
+           for k, v in local.items() if ex.model.get(k) != v]
+    if bad:
+        raise PageShipError(
+            "shipment incompatible with this pool — " + "; ".join(bad))
+    want = (local["n_layers"], ex.n_pages, local["page_size"],
+            local["n_heads"], local["head_dim"])
+    if tuple(ex.pages_k.shape) != want:
+        raise PageShipError(
+            f"shipment page stack {tuple(ex.pages_k.shape)} != "
+            f"{want} for this pool's geometry")
+    if ex.pos != len(ex.prompt):
+        raise PageShipError(
+            f"shipment pos {ex.pos} != prompt length "
+            f"{len(ex.prompt)}: only prefill-complete lanes ship")
+    if not ex.committed:
+        raise PageShipError(
+            "shipment carries no committed token: prefill completion "
+            "always samples the first one")
+    if ex.n_pages != -(-ex.pos // local["page_size"]):
+        raise PageShipError(
+            f"shipment has {ex.n_pages} pages for pos {ex.pos} at "
+            f"page_size {local['page_size']}")
+
+
+__all__ = [
+    "MAGIC",
+    "PageExport",
+    "PageShipError",
+    "WIRE_VERSION",
+    "check_compatible",
+    "deserialize_export",
+    "model_signature",
+    "serialize_export",
+]

@@ -21,7 +21,30 @@ Endpoint parity with `UiServer.run():75-87`:
                               continuous slot-decode pool
                               (serving.ContinuousLMServer); top-k/top-p/
                               beam take the whole-sequence KV path
-                              (beyond the reference: LM serving)
+                              (beyond the reference: LM serving).
+                              `"stream": true` answers
+                              `text/event-stream` — one SSE event per
+                              committed token (speculative rounds emit
+                              several) and a final `done` event carrying
+                              the full ids; a client that disconnects
+                              mid-stream abandons the request, freeing
+                              its slot and KV pages.  An optional
+                              `"session_id"` feeds sticky-session
+                              affinity accounting on every front
+- POST /lm/prefill            disaggregated serving, prefill half
+                              (ISSUE-14): run the prompt through normal
+                              admission but stop at prefill completion
+                              and answer the lane's KV page shipment
+                              (application/octet-stream,
+                              serving/transfer.py wire format) for a
+                              decode worker to admit
+- POST /lm/admit_pages        disaggregated serving, decode half: admit
+                              a shipped lane (binary body), install its
+                              pages, decode to completion — answers
+                              {"ids": ...} byte-identical to a local
+                              /lm/generate; a failed integrity check is
+                              a typed 422 the router answers by
+                              recomputing locally
 - POST /model/predict         batched classifier/regressor inference for
                               the model registered via
                               UiServer.serve_model(net) — concurrent
@@ -305,6 +328,11 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
 
     # ---- POST -------------------------------------------------------------
     def do_POST(self) -> None:  # noqa: N802
+        if self.path == "/lm/admit_pages":
+            # binary body (a KV page shipment) — must not go through the
+            # JSON parse below
+            self._lm_admit_pages()
+            return
         try:
             body = self._body()
         except (ValueError, json.JSONDecodeError) as e:
@@ -388,6 +416,8 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
             self._json(200, {"ok": True})
         elif self.path == "/lm/generate":
             self._lm_generate(body)
+        elif self.path == "/lm/prefill":
+            self._lm_prefill(body)
         elif self.path == "/model/predict":
             # Batched classifier inference (UiServer.serve_model): the
             # request's rows ride whatever coalesced dispatch the
@@ -465,6 +495,8 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
             # fold into int32 range: PRNGKey/device seed dtype
             seed = int(body.get("seed", 0)) & 0x7FFFFFFF
             deadline_s = self._deadline_s(body)
+            session_id = self._session_id(body)
+            stream = bool(body.get("stream", False))
             ids_list = validate_request(cfg, prompt, max_new)
             if temperature < 0:
                 raise ValueError(f"temperature must be >= 0, "
@@ -495,6 +527,20 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
                         "speculate requested but the pool was started "
                         "with speculation off (serve with -lm-speculate "
                         "ngram|model)")
+            if stream:
+                # SSE rides the continuous pool's per-token commits; the
+                # whole-sequence legs decode in one uninterruptible scan
+                # and have nothing to stream — a typed 400 naming why,
+                # not a silently-buffered fake stream
+                if lm_server is None:
+                    raise ValueError(
+                        "stream requested but no continuous LM pool is "
+                        "registered (continuous=False)")
+                if beams > 1 or top_k > 0 or top_p < 1.0:
+                    raise ValueError(
+                        "stream requires the continuous greedy/"
+                        "temperature path: top-k/top-p/beam decode "
+                        "whole-sequence and cannot stream")
         except (ValueError, TypeError) as e:
             # bad prompt/params (incl. null/list-valued knobs) -> 400
             payload = {"error": str(e)}
@@ -512,13 +558,24 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
                 self._json(200, {"ids": np.asarray(out)[0].tolist(),
                                  "score": float(scores[0])})
                 return
+            if stream:
+                # SSE: admission (and its typed failures) happens HERE,
+                # before any response byte commits; tokens then flow as
+                # events from the worker's per-commit pushes
+                gen = lm_server.generate_stream(
+                    ids_list, max_new, temperature=temperature,
+                    seed=seed, deadline_s=deadline_s,
+                    request_id=self.request_id(), session_id=session_id)
+                self._sse_stream(gen, ids_list)
+                return
             if (lm_server is not None and top_k == 0 and top_p >= 1.0):
                 # continuous path: the request shares the slot pool with
                 # whatever else is decoding right now
                 ids = lm_server.generate(ids_list, max_new,
                                          temperature=temperature,
                                          seed=seed, deadline_s=deadline_s,
-                                         request_id=self.request_id())
+                                         request_id=self.request_id(),
+                                         session_id=session_id)
                 self._json(200, {"ids": ids})
                 return
             import jax
@@ -533,6 +590,155 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
             self._json(400, {"error": str(e)})
             return
         self._json(200, {"ids": np.asarray(out)[0].tolist()})
+
+    def _session_id(self, body: Any) -> Optional[str]:
+        """Per-request `"session_id"` (ISSUE-14 satellite): accepted on
+        every front — fleet or bare `serve` — so clients write ONE
+        payload shape; a non-scalar value is the client's 400."""
+        sid = body.get("session_id")
+        if sid is None:
+            return None
+        if not isinstance(sid, (str, int)):
+            raise ValueError(
+                f"session_id must be a string or int, got "
+                f"{type(sid).__name__}")
+        sid = str(sid)
+        if not 0 < len(sid) <= 128:
+            raise ValueError("session_id must be 1..128 characters")
+        return sid
+
+    def _sse_stream(self, gen, prompt_ids: List[int]) -> None:
+        """Relay one token stream as Server-Sent Events: one `data:`
+        event per committed token, a final `done` event with the full
+        ids (so `concat(token events)` and the non-streamed body are
+        mutually checkable), an `error` event if the decode fails
+        mid-stream.  The response is close-delimited (no
+        Content-Length).  A client that disconnects mid-stream raises
+        on the write; closing the generator (finally) abandons the
+        request so its slot and pages free at the next admit round."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        rid = getattr(self, "_request_id", None)
+        if rid is not None:
+            self.send_header("X-Request-Id", rid)
+        self.end_headers()
+        toks: List[int] = []
+        try:
+            try:
+                for tok in gen:
+                    toks.append(int(tok))
+                    self.wfile.write(
+                        b"data: " + json.dumps(
+                            {"token": int(tok),
+                             "index": len(toks) - 1}).encode() + b"\n\n")
+                    self.wfile.flush()
+                self.wfile.write(
+                    b"event: done\ndata: " + json.dumps(
+                        {"ids": list(prompt_ids) + toks}).encode()
+                    + b"\n\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                # mid-stream disconnect: nothing to answer; the finally
+                # below closes the generator, which abandons the request
+                pass
+            except Exception as e:  # noqa: BLE001 — headers already sent; the error must ride the stream
+                try:
+                    self.wfile.write(
+                        b"event: error\ndata: " + json.dumps(
+                            {"error": str(e)}).encode() + b"\n\n")
+                    self.wfile.flush()
+                except OSError:
+                    pass
+        finally:
+            gen.close()
+
+    def _lm_prefill(self, body: Any) -> None:
+        """POST /lm/prefill — the disaggregated prefill half: normal
+        admission and chunked prefill, but the answer is the lane's KV
+        page shipment (binary, serving/transfer.py wire format) instead
+        of a decoded sequence."""
+        s = self.state
+        with s.lock:
+            lm_server = s.lm_server
+            stopping = s.draining
+        if lm_server is None:
+            if stopping:
+                raise ServingUnavailableError(
+                    "server stopped: LM unregistered")
+            self._json(400, {"error": "no continuous LM pool registered: "
+                                      "call UiServer.serve_lm(cfg, "
+                                      "params)"})
+            return
+        prompt = body.get("prompt_ids")
+        if not prompt:
+            self._json(400, {"error": "prompt_ids required"})
+            return
+        if lm_server.kv != "paged" or not lm_server.ship:
+            # typed on the WIRE (the same kind the admit leg's 422
+            # carries): "this worker cannot ship" must be machine-
+            # distinguishable from "this request is bad everywhere" —
+            # the router recomputes on the former and propagates the
+            # latter, and substring-matching error text would rot
+            self._json(422, {"error": "this worker does not ship KV "
+                                      "pages (started without -lm-ship "
+                                      "or with dense KV)",
+                             "kind": "page_ship"})
+            return
+        from deeplearning4j_tpu.serving.transfer import serialize_export
+
+        try:
+            export = lm_server.prefill_export(
+                prompt, int(body.get("max_new_tokens", 32)),
+                temperature=float(body.get("temperature", 0.0)),
+                seed=int(body.get("seed", 0)) & 0x7FFFFFFF,
+                deadline_s=self._deadline_s(body),
+                request_id=self.request_id(),
+                session_id=self._session_id(body))
+        except (ValueError, TypeError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        self._send(200, "application/octet-stream",
+                   serialize_export(export))
+
+    def _lm_admit_pages(self) -> None:
+        """POST /lm/admit_pages — the disaggregated decode half: a
+        binary KV page shipment in, `{"ids": [...]}` out.  Integrity or
+        geometry failures are a typed 422 (`kind: "page_ship"`) — the
+        router's signal to recompute locally, distinct from the 4xx
+        family that means the REQUEST is bad everywhere."""
+        from deeplearning4j_tpu.serving.transfer import (
+            PageShipError,
+            deserialize_export,
+        )
+
+        s = self.state
+        with s.lock:
+            lm_server = s.lm_server
+            stopping = s.draining
+        try:
+            if lm_server is None:
+                if stopping:
+                    raise ServingUnavailableError(
+                        "server stopped: LM unregistered")
+                self._json(400, {"error": "no continuous LM pool "
+                                          "registered"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            data = self.rfile.read(length) if length else b""
+            export = deserialize_export(data)
+            ids = lm_server.admit_with_pages(
+                export, deadline_s=self._deadline_s({}),
+                request_id=self.request_id())
+            self._json(200, {"ids": ids})
+        except PageShipError as e:
+            self._json(422, {"error": str(e), "kind": "page_ship"})
+        except Exception as e:  # noqa: BLE001 — binary leg bypasses do_POST's mapper; same policy applied here
+            if not self.respond_typed_failure(e):
+                if isinstance(e, (ValueError, TypeError)):
+                    self._json(400, {"error": str(e)})
+                else:
+                    self._json(500, {"error": repr(e)})
 
 
 class UiServer:
@@ -572,7 +778,7 @@ class UiServer:
                  kv: str = "paged", page_size: int = 16,
                  pages: Optional[int] = None,
                  prefill_chunk: int = 8, speculate: str = "off",
-                 draft_len: int = 4) -> "UiServer":
+                 draft_len: int = 4, ship: bool = False) -> "UiServer":
         """Register a TransformerLM for POST /lm/generate.  With
         `continuous` (default) greedy/temperature requests decode in a
         `slots`-lane continuous batching pool; `continuous=False` keeps
@@ -602,7 +808,8 @@ class UiServer:
                 default_deadline_s=default_deadline_s, breaker=breaker,
                 kv=kv, page_size=page_size, pages=pages,
                 prefill_chunk=prefill_chunk, speculate=speculate,
-                draft_len=draft_len, tracer=self.state.tracer,
+                draft_len=draft_len, ship=ship,
+                tracer=self.state.tracer,
                 registry=self.state.registry)
         with self.state.lock:
             self.state.lm = (cfg, params)

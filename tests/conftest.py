@@ -69,6 +69,13 @@ def pytest_configure(config):
         "drafts, page-ledger hygiene under rollback-heavy storms, "
         "unsupported-combo admission (fast; run in tier-1)")
     config.addinivalue_line(
+        "markers", "disagg: disaggregated prefill/decode serving — KV "
+        "page shipping wire format + integrity, shipped-lane byte "
+        "parity vs generate(), role-based fleet routing with the "
+        "recompute failure ladder, sticky sessions, SSE token "
+        "streaming incl. mid-stream disconnect hygiene (fast; run in "
+        "tier-1)")
+    config.addinivalue_line(
         "markers", "elastic: elastic checkpoint plane — sharded "
         "snapshots with SHA-256 integrity, two-phase atomic commit "
         "(kill -9 at every boundary), N→M topology-elastic restore, "

@@ -257,7 +257,7 @@ class TestFailover:
         seen = {}
 
         def fake_submit(path, body, key=None, timeout=None,
-                        request_id=None):
+                        request_id=None, roles=None, session_id=None):
             seen["path"], seen["body"] = path, body
             return {"ids": [1]}
 

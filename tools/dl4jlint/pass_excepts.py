@@ -70,8 +70,18 @@ LAUNCHER_PREFIX = "deeplearning4j_tpu/runtime/launcher.py"
 OBS_ALLOWLIST: dict = {}
 OBS_PREFIX = "deeplearning4j_tpu/obs/"
 
-# prefix -> (allowlist, label) for the strict-mode passes
+# The KV page-shipping wire plane (ISSUE-14) carries serving state
+# BETWEEN processes: a swallowed parse/integrity error here installs
+# silent garbage KV on a decode worker — no broad handlers at all,
+# pragma'd or not.  Listed before the serving/ prefix so the ceiling
+# stays explicitly EMPTY even if serving/ ever grows an entry for it.
+TRANSFER_ALLOWLIST: dict = {}
+TRANSFER_PREFIX = "deeplearning4j_tpu/serving/transfer.py"
+
+# prefix -> (allowlist, label) for the strict-mode passes (first match
+# wins, so file-level prefixes go before their parent directory)
 STRICT_PREFIXES = (
+    (TRANSFER_PREFIX, TRANSFER_ALLOWLIST, "TRANSFER_ALLOWLIST"),
     (SERVING_PREFIX, SERVING_ALLOWLIST, "SERVING_ALLOWLIST"),
     (OBS_PREFIX, OBS_ALLOWLIST, "OBS_ALLOWLIST"),
     (LAUNCHER_PREFIX, LAUNCHER_ALLOWLIST, "LAUNCHER_ALLOWLIST"),

@@ -5,7 +5,8 @@
 	test-procfleet dryrun bench smoke serving-smoke bench-precision \
 	bench-fleet bench-paged bench-procfleet test-obs bench-obs \
 	obs-smoke evidence lint test-lint test-elastic bench-elastic \
-	test-spec bench-spec test-disagg bench-disagg
+	test-spec bench-spec test-disagg bench-disagg test-pressure \
+	bench-pressure
 
 # lint first: the four-pass static sweep is ~1s and fails fast on a
 # race/host-sync/recompile-hazard/broad-except finding before the
@@ -79,6 +80,21 @@ test-disagg:
 bench-disagg:
 	BENCH_ONLY=disagg python bench.py
 
+# Overload-survival tests only (priority admission ordering, KV lane
+# preemption + host swap-out byte parity, swap eviction/corruption
+# recompute fallback, brownout ladder hysteresis, pool-exhaustion
+# chaos regression, role-aware autoscale signals).
+test-pressure:
+	python -m pytest tests/ -q -m pressure
+
+# Overload-survival bench row: a mixed-priority storm sized to >2x the
+# paged pool's capacity, survival plane (priorities + preemption +
+# brownout) vs the all-FIFO baseline — gates zero failed interactive
+# requests, interactive p99 under the FIFO baseline, ladder
+# transitions counted, pool ledger + swap byte-cap honored.
+bench-pressure:
+	BENCH_ONLY=pressure python bench.py
+
 # Observability-plane tests only (metrics registry + exposition,
 # request tracing across the fleet, compile watcher, training
 # telemetry; docs/observability.md).
@@ -131,7 +147,7 @@ smoke:
 # + the overload/admission-control row + the fleet mid-storm-kill row +
 # the paged-KV shared-prefix row).
 serving-smoke:
-	BENCH_ONLY=serving,servinglm,servingoverload,servingfleet,paged,speculative,disagg python bench.py
+	BENCH_ONLY=serving,servinglm,servingoverload,servingfleet,paged,speculative,disagg,pressure python bench.py
 
 # Precision-plane tests only (bf16-mixed parity/determinism, loss-scaler
 # overflow recovery, int8 serving agreement, dtype round-trips).

@@ -1704,6 +1704,190 @@ def bench_paged_kv() -> dict:
                     "top of it"}
 
 
+def bench_pressure() -> dict:
+    """Overload-survival row (ISSUE-15 acceptance): a mixed-priority
+    storm whose total KV page demand is sized to >2x the paged pool's
+    capacity, served twice by the SAME pool sizing:
+
+    - baseline: the pre-ISSUE-15 pool — no priorities (every request
+      FIFO by arrival), no preemption, no brownout.  Latency-sensitive
+      requests queue behind long best_effort lanes pinning pages.
+    - survival: priorities + KV lane preemption with host swap-out +
+      the brownout degradation ladder.
+
+    Gates: ZERO failed interactive requests on the survival leg
+    (best_effort may be shed with Retry-After at ladder level 4 —
+    those retry and are counted, never silent); interactive p99 under
+    the all-FIFO baseline; at least one degradation-ladder transition
+    counted; the page ledger balanced and the swap store's byte high
+    water under its cap; zero XLA compiles after warmup."""
+    import dataclasses
+    import threading
+
+    import jax
+    import jax.monitoring
+
+    from deeplearning4j_tpu.parallel import transformer as tfm
+    from deeplearning4j_tpu.serving import ContinuousLMServer
+    from deeplearning4j_tpu.serving.resilience import (
+        ServingOverloadError,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = tfm.gpt2_small(max_len=256)
+        ps, pool_pages, slots = 16, 24, 8
+        shapes = [("interactive", 8, 24), ("batch", 24, 48),
+                  ("best_effort", 8, 120)]
+        per_class = 8
+    else:
+        cfg = dataclasses.replace(
+            tfm.gpt2_small(max_len=80), vocab_size=256, d_model=64,
+            n_heads=4, n_layers=2, d_ff=256, dtype="float32",
+            remat=False)
+        ps, pool_pages, slots = 16, 12, 4
+        shapes = [("interactive", 8, 12), ("batch", 16, 40),
+                  ("best_effort", 8, 72)]
+        per_class = 6
+    rng = np.random.default_rng(0)
+    requests = []      # (priority, prompt, max_new)
+    demand_pages = 0
+    for prio, plen, new in shapes:
+        for _ in range(per_class):
+            prompt = rng.integers(0, cfg.vocab_size, (plen,)).tolist()
+            requests.append((prio, prompt, new))
+            demand_pages += -(-(plen + new - 1) // ps)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+
+    def storm(srv, with_priority: bool):
+        """Batch + best_effort clients release at t0; the interactive
+        wave lands 50ms later, when the long lanes already pin pages —
+        the head-of-line scenario the survival plane exists for (both
+        legs get the identical arrival pattern).  Returns (per-class
+        latencies, failed-by-class, shed-retries)."""
+        lats = {p: [] for p, _, _ in shapes}
+        failed = {p: 0 for p, _, _ in shapes}
+        shed_retries = [0]
+        barrier = threading.Barrier(len(requests) + 1)
+        lock = threading.Lock()
+
+        def client(i):
+            prio, prompt, new = requests[i]
+            kw = {"priority": prio} if with_priority else {}
+            barrier.wait()
+            if prio == "interactive":
+                time.sleep(0.05)
+            t0 = time.perf_counter()
+            for _ in range(200):
+                try:
+                    srv.generate(list(prompt), new, timeout=600, **kw)
+                    with lock:
+                        lats[prio].append(time.perf_counter() - t0)
+                    return
+                except ServingOverloadError as e:
+                    # ladder level 4 shedding best_effort: back off
+                    # as told and retry — counted, never silent
+                    with lock:
+                        shed_retries[0] += 1
+                    time.sleep(min(0.25, e.retry_after_s))
+                except Exception:  # noqa: BLE001 — tallied as failed
+                    break
+            with lock:
+                failed[prio] += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(requests))]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        for t in threads:
+            t.join()
+        return lats, failed, shed_retries[0]
+
+    def p99(xs):
+        if not xs:
+            return None
+        return round(float(np.percentile(xs, 99)) * 1e3, 1)
+
+    # ---- baseline: all-FIFO, no survival plane ---------------------------
+    base = ContinuousLMServer(cfg, params, slots=slots, kv="paged",
+                              page_size=ps, pages=pool_pages,
+                              prefill_chunk=4)
+    try:
+        base.warmup()
+        base_lats, base_failed, _ = storm(base, with_priority=False)
+    finally:
+        base.stop()
+
+    # ---- survival: priorities + preemption + brownout --------------------
+    srv = ContinuousLMServer(cfg, params, slots=slots, kv="paged",
+                             page_size=ps, pages=pool_pages,
+                             prefill_chunk=4, preempt=True,
+                             brownout=True)
+    compiles = []
+
+    def listener(event, duration, **kw):
+        if event == "/jax/core/compile/backend_compile_duration":
+            compiles.append(event)
+
+    try:
+        srv.warmup()
+        jax.monitoring.register_event_duration_secs_listener(listener)
+        try:
+            lats, failed, shed_retries = storm(srv, with_priority=True)
+        finally:
+            jax.monitoring.clear_event_listeners()
+        stats = srv.stats()
+        with srv._cond:
+            ledger = srv._pool.check_ledger()
+            swap = srv._swap.stats()
+    finally:
+        srv.stop()
+
+    ia_p99, base_ia_p99 = p99(lats["interactive"]), p99(
+        base_lats["interactive"])
+    br = stats.get("pressure", {}).get("brownout", {})
+    transitions = int(br.get("transitions_up", 0)
+                      + br.get("transitions_down", 0))
+    swap_cap_ok = swap["peak_bytes"] <= swap["capacity_bytes"]
+    meets = bool(
+        failed["interactive"] == 0
+        and ia_p99 is not None and base_ia_p99 is not None
+        and ia_p99 < base_ia_p99
+        and transitions >= 1
+        and ledger["balanced"] and swap_cap_ok and not compiles)
+    return {"metric": "TransformerLM overload-survival interactive p99 "
+                      f"(mixed-priority storm, {demand_pages}-page "
+                      f"demand on a {pool_pages}-page pool)",
+            "unit": "ms", "value": ia_p99,
+            "requests": len(requests),
+            "demand_pages": demand_pages, "pool_pages": pool_pages,
+            "demand_over_capacity": round(demand_pages / pool_pages, 2),
+            **_mem_fields(params=params),
+            "fifo_interactive_p99_ms": base_ia_p99,
+            "interactive_p99_vs_fifo": (
+                round(base_ia_p99 / ia_p99, 2)
+                if ia_p99 and base_ia_p99 else None),
+            "batch_p99_ms": p99(lats["batch"]),
+            "best_effort_p99_ms": p99(lats["best_effort"]),
+            "failed": dict(failed),
+            "fifo_failed": dict(base_failed),
+            "shed_retries": shed_retries,
+            "preemptions": stats.get("preemptions", 0),
+            "swap": stats.get("swap"),
+            "swap_peak_bytes": swap["peak_bytes"],
+            "swap_capacity_bytes": swap["capacity_bytes"],
+            "brownout_level_final": br.get("level"),
+            "brownout_transitions": transitions,
+            "ledger_balanced": ledger["balanced"],
+            "off_ladder_compiles": len(compiles),
+            "meets_acceptance": meets,
+            "note": "same pool sizing both legs; the survival leg adds "
+                    "priorities, preemption with host swap-out, and "
+                    "the brownout ladder — interactive latency is what "
+                    "the plane exists to protect"}
+
+
 def bench_speculative() -> dict:
     """Speculative-decode row (ISSUE-13 acceptance): the bench_paged_kv
     shared-prefix greedy storm served by the PR-7 paged pool
@@ -2295,6 +2479,7 @@ BENCHES = {
     "obs": bench_obs,
     "paged": bench_paged_kv,
     "speculative": bench_speculative,
+    "pressure": bench_pressure,
     "precision": bench_precision,
     "flashab": bench_flash_ab,
     "longctx": bench_longctx,

@@ -503,6 +503,10 @@ def cmd_serve(args) -> int:
             raise SystemExit(
                 "serve: -lm-ship requires -lm-kv paged (page shipping "
                 "moves block-table pages)")
+        if (args.lm_preempt or args.lm_brownout) and args.lm_kv != "paged":
+            raise SystemExit(
+                "serve: -lm-preempt/-lm-brownout require -lm-kv paged "
+                "(the overload-survival plane swaps block-table pages)")
         cfg, params = _load_saved_lm(pathlib.Path(args.lm))
         srv.serve_lm(cfg, params, slots=args.lm_slots,
                      max_queue_depth=max_queue,
@@ -513,7 +517,10 @@ def cmd_serve(args) -> int:
                      prefill_chunk=args.prefill_chunk,
                      speculate=args.lm_speculate,
                      draft_len=args.draft_len,
-                     ship=args.lm_ship)
+                     ship=args.lm_ship,
+                     preempt=args.lm_preempt,
+                     swap_bytes=int(args.lm_swap_mb * (1 << 20)),
+                     brownout=args.lm_brownout)
         lm_srv = srv.state.lm_server
         # -warmup opts the LM pool into pre-traffic compiles too, same
         # contract as the classifier path: without it each program
@@ -527,6 +534,11 @@ def cmd_serve(args) -> int:
                          f"(draft_len {lm_srv.draft_len})"
                          if lm_srv.speculate != "off" else "")
             spec_note += ", page shipping on" if lm_srv.ship else ""
+            if lm_srv.preempt:
+                spec_note += (f", preemption on (swap cap "
+                              f"{args.lm_swap_mb:g} MiB)")
+            if args.lm_brownout:
+                spec_note += ", brownout ladder on"
             print(f"serve: LM registered ({cfg.n_layers}L/d{cfg.d_model}, "
                   f"max_len {cfg.max_len}, {args.lm_slots} decode slots, "
                   f"paged KV: {lm_srv.kv_pages} pages x "
@@ -663,10 +675,14 @@ def cmd_serve_fleet(args) -> int:
             lm_prefill_chunk=args.prefill_chunk,
             lm_ship=bool(args.lm_ship), role=role)
 
-    def factory(name: str):
-        # autoscale/rolling-swap spawns: decode capacity is what queue
-        # depth buys in a role-split fleet; "both" otherwise
-        return spawn(name, "decode" if role_split else "both")
+    def factory(name: str, role: str = None):
+        # autoscale/rolling-swap spawns: role-aware autoscaling names
+        # the role pool it is growing (ISSUE-15 satellite — a prefill
+        # backlog grows the prefill pool); unnamed spawns buy decode
+        # capacity in a role-split fleet, "both" otherwise
+        if role is None:
+            role = "decode" if role_split else "both"
+        return spawn(name, role)
 
     router = FleetRouter(
         factory, replicas=0 if role_split else args.replicas,
@@ -1379,6 +1395,28 @@ def build_parser() -> argparse.ArgumentParser:
                               "can serve a disaggregated prefill/"
                               "decode fleet (paged KV only; "
                               "docs/architecture.md)")
+    p_serve.add_argument("-lm-preempt", "--lm-preempt",
+                         dest="lm_preempt", action="store_true",
+                         help="priority preemption for the LM pool: a "
+                              "higher-priority request that would wait "
+                              "on a dry KV pool preempts the lowest-"
+                              "priority lane, swapping its state to a "
+                              "host store; the lane resumes byte-"
+                              "identically on re-admission (paged KV "
+                              "only; docs/robustness.md \"The "
+                              "degradation ladder\")")
+    p_serve.add_argument("-lm-swap-mb", "--lm-swap-mb",
+                         dest="lm_swap_mb", type=float, default=64.0,
+                         help="host swap store byte cap in MiB for "
+                              "preempted lanes (LRU past it; an "
+                              "evicted lane recomputes from its "
+                              "prompt, still byte-identical)")
+    p_serve.add_argument("-lm-brownout", "--lm-brownout",
+                         dest="lm_brownout", action="store_true",
+                         help="brownout degradation ladder: under pool "
+                              "pressure degrade speculation, prefill "
+                              "width, then best_effort lanes before "
+                              "shedding anything (paged KV only)")
     p_serve.add_argument("-serve-seconds", "--serve-seconds",
                          dest="serve_seconds", type=float, default=0,
                          help="stop after this many seconds (0 = run "

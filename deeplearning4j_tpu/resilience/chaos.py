@@ -38,6 +38,13 @@ autoscale), and readyz probes lie at configured poll indices (drives
 eject -> half-open probe -> re-admit without killing anything) — again
 deterministic, counter-driven, CPU-only.
 
+The OVERLOAD-SURVIVAL plane (ISSUE-15) gets pool + swap faults:
+`chaos_pool` denies configured `PagePool.alloc` calls (deterministic
+exhaustion driving the FIFO-wait and preemption paths without touching
+the refcount ledger), and `chaos_swap` corrupts or drops configured
+`SwapStore.put`s so the restore path's SHA-256 detection and the
+recompute-from-prompt fallback both run in tier-1.
+
 Process SUPERVISION (ISSUE-10) gets real-process faults: `chaos_procfleet`
 SIGKILLs / SIGSTOPs actual worker processes at configured dispatch
 attempts and boot-flakes configured spawns (exit-code-N commands), so
@@ -441,6 +448,108 @@ def chaos_procfleet(supervisor,
     Returns the installed wrapper; ``.uninstall()`` restores the real
     hooks."""
     return _ProcessChaos(supervisor, config)
+
+
+# ---------------------------------------------------------------------------
+# Overload-survival fault injection (ISSUE-15: preemption + brownout)
+
+
+@dataclasses.dataclass(frozen=True)
+class SwapChaosConfig:
+    """Host swap-store faults, keyed by put order (0-based).
+
+    - ``corrupt_puts``: the blob stored at each listed put index has
+      ONE byte flipped mid-payload BEFORE it enters the store — the
+      deterministic stand-in for host-memory bit rot in a swapped-out
+      lane.  The wire frame's SHA-256 check must catch it at restore
+      and the pool must recompute that lane from its prompt (typed
+      `PageShipError` in the ledger/trace, byte-identical output,
+      never a wrong token).
+    - ``drop_puts``: the put at each listed index is silently NOT
+      stored — the deterministic stand-in for byte-cap eviction.  The
+      restore path must surface `SwapEvictedError` internally and
+      recompute, same contract.
+    """
+
+    corrupt_puts: Sequence[int] = ()
+    drop_puts: Sequence[int] = ()
+
+
+class _SwapChaos:
+    """Installed over a `SwapStore`'s `put` (instance attribute shadows
+    the method).  Counter: ``puts`` (calls seen)."""
+
+    def __init__(self, store, config: SwapChaosConfig):
+        self.store = store
+        self.config = config
+        self.puts = 0
+        self._orig_put = store.put
+        store.put = self._put
+
+    def uninstall(self) -> None:
+        self.store.put = self._orig_put
+
+    def _put(self, key: str, blob: bytes):
+        i = self.puts
+        self.puts += 1
+        if i in self.config.drop_puts:
+            # pretend the cap evicted it instantly: stored nowhere, so
+            # take() raises the typed SwapEvictedError at restore
+            return []
+        if i in self.config.corrupt_puts:
+            # flip the LAST byte — always inside the raw page payload,
+            # so the frame parses fine and the SHA-256 integrity check
+            # is what catches it (the exact fault class the hash is for)
+            pos = len(blob) - 1
+            blob = blob[:pos] + bytes([blob[pos] ^ 0xFF])
+        return self._orig_put(key, blob)
+
+
+def chaos_swap(store, config: SwapChaosConfig) -> _SwapChaos:
+    """Install deterministic swap-store faults on a
+    `serving.pressure.SwapStore` (see `SwapChaosConfig`); returns the
+    wrapper — ``.uninstall()`` restores the real `put`."""
+    return _SwapChaos(store, config)
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolChaosConfig:
+    """Paged-pool exhaustion faults, keyed by alloc order (0-based):
+    ``deny_allocs`` lists alloc calls that return None (the pool
+    pretends to be dry) regardless of the free list — drives the
+    FIFO head-of-line wait and the preemption path deterministically
+    without corrupting the refcount ledger."""
+
+    deny_allocs: Sequence[int] = ()
+
+
+class _PoolChaos:
+    """Installed over a `PagePool`'s `alloc` (instance attribute
+    shadows the method).  Counter: ``allocs`` (calls seen)."""
+
+    def __init__(self, pool, config: PoolChaosConfig):
+        self.pool = pool
+        self.config = config
+        self.allocs = 0
+        self._orig_alloc = pool.alloc
+        pool.alloc = self._alloc
+
+    def uninstall(self) -> None:
+        self.pool.alloc = self._orig_alloc
+
+    def _alloc(self, n: int):
+        i = self.allocs
+        self.allocs += 1
+        if i in self.config.deny_allocs:
+            return None
+        return self._orig_alloc(n)
+
+
+def chaos_pool(pool, config: PoolChaosConfig) -> _PoolChaos:
+    """Install deterministic exhaustion faults on a
+    `serving.paged.PagePool` (see `PoolChaosConfig`); returns the
+    wrapper — ``.uninstall()`` restores the real `alloc`."""
+    return _PoolChaos(pool, config)
 
 
 # ---------------------------------------------------------------------------

@@ -246,6 +246,7 @@ def replica_serve_command(model_dir: Optional[str], *,
                           lm_page_size: Optional[int] = None,
                           prefill_chunk: Optional[int] = None,
                           lm_ship: bool = False,
+                          drain_stats: Optional[str] = None,
                           python: Optional[str] = None) -> List[str]:
     """The command line for ONE process-hosted serving replica: a
     `dl4j serve` worker on its own port, with graceful SIGTERM drain
@@ -289,6 +290,12 @@ def replica_serve_command(model_dir: Optional[str], *,
         cmd += ["-breaker-threshold", str(int(breaker_threshold))]
     if quantize:
         cmd += ["-quantize", quantize]
+    # the SIGTERM drain snapshot must never land in whatever CWD the
+    # parent happened to run from (`serve`'s default is a relative
+    # serving_stats.json — a worker fleet would litter the repo root);
+    # callers that care pass a real path, everyone else discards it
+    cmd += ["-drain-stats", str(drain_stats) if drain_stats
+            else os.devnull]
     return cmd
 
 
@@ -361,6 +368,11 @@ class FleetProcessLauncher:
         return self.roles[int(i)]
 
     def command(self, i: int) -> List[str]:
+        # worker drain snapshots ride the log dir (one file per worker)
+        # or are discarded — never the parent's CWD
+        drain = (str(pathlib.Path(self.log_dir)
+                     / f"worker-{i}.drain.json")
+                 if self.log_dir is not None else None)
         return replica_serve_command(
             self.model_dir, host=self.host, port=self.port(i),
             buckets=self.buckets, max_batch=self.max_batch,
@@ -369,7 +381,8 @@ class FleetProcessLauncher:
             breaker_threshold=self.breaker_threshold,
             quantize=self.quantize, lm_dir=self.lm_dir,
             lm_slots=self.lm_slots, lm_page_size=self.lm_page_size,
-            prefill_chunk=self.prefill_chunk, lm_ship=self.lm_ship)
+            prefill_chunk=self.prefill_chunk, lm_ship=self.lm_ship,
+            drain_stats=drain)
 
     def log_path(self, i: int) -> Optional[pathlib.Path]:
         if self.log_dir is None:

@@ -65,6 +65,20 @@ coalescing K concurrent *requests* per device dispatch.
   served by shipping; SSE token streaming on `/lm/generate`
   (`"stream": true`) makes time-to-first-token a first-class
   measurement (docs/architecture.md "Disaggregated serving");
+- overload survival (`pressure.py`, ISSUE-15): per-request `priority`
+  (`interactive` > `batch` > `best_effort`) accepted on every front,
+  with the LM pool's admission queue priority-ordered; KV lane
+  PREEMPTION with host swap-out (`ContinuousLMServer(preempt=True)`) —
+  a higher-priority request that would wait on a dry `PagePool`
+  preempts the lowest-priority lane, gathers its pages through the
+  shipping wire frame into a byte-capped LRU `SwapStore`, and the lane
+  resumes BYTE-IDENTICALLY on re-admission (evicted/corrupt swap state
+  is a typed `SwapEvictedError`/SHA-256 failure and the lane recomputes
+  from its prompt — still byte-identical); and the `BrownoutLadder`
+  degradation automaton (`brownout=True`) that degrades speculation,
+  prefill width, then best_effort lanes before shedding anything,
+  hysteresis both directions, every transition counted
+  (docs/robustness.md "The degradation ladder");
 - process supervision (`procfleet.py`, ISSUE-10): `FleetSupervisor`
   owns spawned worker processes end-to-end — exit-status + `/readyz`
   crash detection with clean/crash/wedged classification, exponential
@@ -109,6 +123,14 @@ from deeplearning4j_tpu.serving.paged import (
     PagePool,
     RadixPrefixCache,
 )
+from deeplearning4j_tpu.serving.pressure import (
+    BrownoutLadder,
+    PRIORITY_CLASSES,
+    PressureConfig,
+    SwapEvictedError,
+    SwapStore,
+    normalize_priority,
+)
 from deeplearning4j_tpu.serving.procfleet import (
     CrashLoopError,
     FleetSupervisor,
@@ -133,6 +155,7 @@ from deeplearning4j_tpu.serving.transfer import (
 )
 
 __all__ = [
+    "BrownoutLadder",
     "BucketLadder",
     "CircuitBreaker",
     "CircuitOpenError",
@@ -150,6 +173,8 @@ __all__ = [
     "NgramDrafter",
     "PageExport",
     "PageShipError",
+    "PRIORITY_CLASSES",
+    "PressureConfig",
     "RestartPolicy",
     "ROLE_BOTH",
     "ROLE_DECODE",
@@ -163,11 +188,14 @@ __all__ = [
     "ServingMetrics",
     "ServingOverloadError",
     "ServingUnavailableError",
+    "SwapEvictedError",
+    "SwapStore",
     "UnservableShapeError",
     "WorkerSpec",
     "check_compatible",
     "check_fleet_ledger",
     "deserialize_export",
+    "normalize_priority",
     "pow2_length_buckets",
     "serialize_export",
     "spawn_local_replica",

@@ -76,6 +76,7 @@ from __future__ import annotations
 import collections
 import hashlib
 import http.client
+import inspect
 import json
 import subprocess
 import threading
@@ -277,6 +278,9 @@ def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
                         lm_speculate: str = "off",
                         lm_draft_len: int = 4,
                         lm_ship: bool = False,
+                        lm_preempt: bool = False,
+                        lm_swap_bytes: int = 64 << 20,
+                        lm_brownout=None,
                         role: str = ROLE_BOTH,
                         version: int = 0) -> Replica:
     """Thread-hosted replica: an in-process `UiServer` on a free port
@@ -315,7 +319,8 @@ def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
                      kv=lm_kv, page_size=lm_page_size, pages=lm_pages,
                      prefill_chunk=lm_prefill_chunk,
                      speculate=lm_speculate, draft_len=lm_draft_len,
-                     ship=ship)
+                     ship=ship, preempt=lm_preempt,
+                     swap_bytes=lm_swap_bytes, brownout=lm_brownout)
         # warm the paged programs BEFORE the replica enters rotation —
         # same zero-compile-on-the-request-path rule as warmup_example
         if srv.state.lm_server is not None:
@@ -431,16 +436,37 @@ class FleetRouter:
             self._replicas.append(replica)
         return replica
 
-    def add_replica(self) -> Replica:
-        """Spawn (via the factory) and attach one replica."""
+    def add_replica(self, role: Optional[str] = None) -> Replica:
+        """Spawn (via the factory) and attach one replica.  `role`
+        (ISSUE-15 satellite) puts the new replica into a specific role
+        group — how role-aware autoscaling grows the prefill and
+        decode pools independently.  A factory that accepts a `role`
+        keyword gets it (so it can build a ship-capable pool for a
+        role-differentiated worker); otherwise the replica is
+        re-stamped after the fact — role is ROUTER state (every worker
+        serves the same surface), and a re-stamped worker whose pool
+        happens not to ship only ever costs recompute fallbacks, never
+        failed requests."""
         if self.factory is None:
             raise ValueError("no replica factory configured")
+        if role is not None and role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
         with self._lock:
             name = f"replica-{self._seq}"
             self._seq += 1
             version = self._version
-        replica = self.factory(name)
+        takes_role = False
+        if role is not None:
+            try:
+                takes_role = "role" in inspect.signature(
+                    self.factory).parameters
+            except (TypeError, ValueError):
+                takes_role = False
+        replica = (self.factory(name, role=role) if takes_role
+                   else self.factory(name))
         replica.version = version
+        if role is not None:
+            replica.role = role
         return self.attach(replica)
 
     def remove(self, replica: Replica, grace_s: float = 5.0) -> bool:
@@ -791,7 +817,8 @@ class FleetRouter:
                          deadline_s: Optional[float] = None,
                          timeout: Optional[float] = None,
                          request_id: Optional[str] = None,
-                         session_id: Optional[str] = None) -> Dict:
+                         session_id: Optional[str] = None,
+                         priority: Optional[str] = None) -> Dict:
         """LM generation with affinity routing and role scheduling.
 
         Affinity: a sticky `session_id` (when sent) or the first
@@ -820,6 +847,10 @@ class FleetRouter:
                       "temperature": float(temperature), "seed": int(seed)}
         if session_id is not None:
             body["session_id"] = str(session_id)
+        if priority is not None:
+            # forwarded verbatim: the replica's admission gate owns the
+            # vocabulary, so an unknown class 400s there and propagates
+            body["priority"] = str(priority)
         if int(top_k):
             body["top_k"] = int(top_k)
         if float(top_p) < 1.0:
@@ -1041,7 +1072,8 @@ class FleetRouter:
                        deadline_s: Optional[float] = None,
                        timeout: Optional[float] = None,
                        request_id: Optional[str] = None,
-                       session_id: Optional[str] = None):
+                       session_id: Optional[str] = None,
+                       priority: Optional[str] = None):
         """Open one SSE token stream against a decode-capable replica
         (affinity-routed like `generate_payload`); returns the raw
         `http.client`-style response object — the caller relays/parses
@@ -1068,6 +1100,8 @@ class FleetRouter:
             body["beam_size"] = int(beam_size)
         if session_id is not None:
             body["session_id"] = str(session_id)
+        if priority is not None:
+            body["priority"] = str(priority)
         if deadline_s is not None:
             body["deadline_ms"] = float(deadline_s) * 1e3
         rid = request_id or new_request_id()
@@ -1314,28 +1348,62 @@ class FleetRouter:
 
     # ---- queue-depth-driven scaling ---------------------------------------
 
+    def queue_depth_by_role(self) -> Dict[str, int]:
+        """Router-side queue-depth proxy split per replica role
+        (ISSUE-15 satellite; the `fleet_queue_depth{role}` gauge): the
+        summed in-flight of active replicas in each role that has any.
+        The split is what lets autoscaling grow prefill and decode
+        pools independently — the aggregate number is decode-biased
+        because decode requests live for the whole token loop while
+        prefill requests come and go."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for r in self._replicas:
+                if r.state == REPLICA_ACTIVE:
+                    out[r.role] = out.get(r.role, 0) + r.in_flight
+            return out
+
     def autoscale_tick(self, grace_s: float = 5.0) -> int:
-        """One scaling decision from the router-side queue-depth proxy
-        (mean in-flight per active replica).  Returns +1 (scaled up),
-        -1 (scaled down through graceful drain) or 0."""
+        """One scaling decision from the router-side queue-depth proxy,
+        evaluated PER ROLE (mean in-flight per active replica of that
+        role) so a prefill backlog grows the prefill pool and a decode
+        backlog the decode pool, independently.  An undifferentiated
+        fleet (every replica `both`) is one role group — exactly the
+        historic fleet-wide behavior.  At most one action per tick
+        (roles evaluated in sorted order, scale-up first): +1 scaled
+        up, -1 scaled down through graceful drain, 0 nothing."""
         with self._lock:
             active = [r for r in self._replicas
                       if r.state == REPLICA_ACTIVE]
-            if not active:
-                return 0
-            load = sum(r.in_flight for r in active) / len(active)
-        if (load > self.scale_up_depth and len(active) < self.max_replicas
-                and self.factory is not None):
-            self.add_replica()
-            with self._lock:
-                self.scale_ups += 1
-            return 1
-        if load < self.scale_down_depth and len(active) > self.min_replicas:
-            victim = min(active, key=lambda r: (r.in_flight, r.name))
-            self.remove(victim, grace_s)
-            with self._lock:
-                self.scale_downs += 1
-            return -1
+        if not active:
+            return 0
+        groups: Dict[str, List[Replica]] = {}
+        for r in active:
+            groups.setdefault(r.role, []).append(r)
+        loads = {role: sum(r.in_flight for r in rs) / len(rs)
+                 for role, rs in groups.items()}
+        if len(active) < self.max_replicas and self.factory is not None:
+            for role in sorted(groups):
+                if loads[role] > self.scale_up_depth:
+                    self.add_replica(
+                        role=role if len(groups) > 1 else None)
+                    with self._lock:
+                        self.scale_ups += 1
+                    return 1
+        if len(active) > self.min_replicas:
+            for role in sorted(groups):
+                rs = groups[role]
+                # never drain a role's LAST replica while other roles
+                # exist — a disaggregated fleet with zero prefill
+                # workers silently loses its split
+                if len(rs) < 2 and len(groups) > 1:
+                    continue
+                if loads[role] < self.scale_down_depth:
+                    victim = min(rs, key=lambda r: (r.in_flight, r.name))
+                    self.remove(victim, grace_s)
+                    with self._lock:
+                        self.scale_downs += 1
+                    return -1
         return 0
 
     # ---- stats / lifecycle ------------------------------------------------
@@ -1390,6 +1458,10 @@ class FleetRouter:
         fleet["replicas_routable"] = sum(
             1 for r in replicas if r.routable())
         fleet.update(counters)
+        # role-split queue-depth proxy (ISSUE-15 satellite): the
+        # autoscaler's per-role input, exposed so operators can see
+        # WHY a role pool grew (the aggregate is decode-biased)
+        fleet["queue_depth_by_role"] = self.queue_depth_by_role()
         # fleet-level LM prefix-reuse view (ISSUE-7): the router's
         # prefix-affinity hashing exists to concentrate shared prompts
         # per replica — this is the number that says whether it worked
@@ -1439,6 +1511,39 @@ class FleetRouter:
                 or disagg["session_affinity_hits"] or sess_hits
                 or any(r.role != ROLE_BOTH for r in replicas)):
             fleet["disagg"] = disagg
+        # fleet-level overload-survival view (ISSUE-15): preemption,
+        # host-swap, and brownout aggregated across the LM pools —
+        # fleet brownout level is the WORST replica's (a fleet is as
+        # degraded as its most degraded pool)
+        pressure = {"preemptions": 0, "swap_out": 0, "swap_in": 0,
+                    "swap_evicted": 0, "swap_corrupt": 0,
+                    "brownout_level": 0, "brownout_transitions": 0,
+                    "brownout_shed": 0}
+        saw_pressure = False
+        for payload in stats_by_name.values():
+            lm = (payload or {}).get("lm") or {}
+            if lm.get("preemptions"):
+                pressure["preemptions"] += int(lm["preemptions"])
+                saw_pressure = True
+            swap = lm.get("swap") or {}
+            if swap:
+                pressure["swap_out"] += int(swap.get("out") or 0)
+                pressure["swap_in"] += int(swap.get("in") or 0)
+                pressure["swap_evicted"] += int(
+                    swap.get("evicted") or 0)
+                pressure["swap_corrupt"] += int(
+                    swap.get("corrupt") or 0)
+                saw_pressure = True
+            br = lm.get("brownout") or {}
+            if br:
+                pressure["brownout_level"] = max(
+                    pressure["brownout_level"], int(br.get("level") or 0))
+                pressure["brownout_transitions"] += int(
+                    br.get("transitions") or 0)
+                pressure["brownout_shed"] += int(br.get("shed") or 0)
+                saw_pressure = True
+        if saw_pressure:
+            fleet["lm_pressure"] = pressure
         out = {"fleet": fleet, "replicas": entries, "retired": retired}
         supervisor = self.supervisor
         if supervisor is not None:
@@ -1653,7 +1758,8 @@ class _FleetHandler(ServingHTTPMixin, BaseHTTPRequestHandler):
                 beam_size=int(body.get("beam_size", 0)),
                 deadline_s=self._deadline_s(body),
                 request_id=self.request_id(),
-                session_id=session_id)
+                session_id=session_id,
+                priority=body.get("priority"))
             self._json(200, payload)
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
@@ -1672,7 +1778,8 @@ class _FleetHandler(ServingHTTPMixin, BaseHTTPRequestHandler):
             top_p=float(body.get("top_p", 1.0)),
             beam_size=int(body.get("beam_size", 0)),
             deadline_s=self._deadline_s(body),
-            request_id=self.request_id(), session_id=session_id)
+            request_id=self.request_id(), session_id=session_id,
+            priority=body.get("priority"))
         try:
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
@@ -1780,6 +1887,12 @@ class FleetServer:
             yield ("fleet_role_requests_total", "counter",
                    "successful dispatches by replica role",
                    {"role": role}, float(n))
+        # per-role queue-depth gauge (ISSUE-15 satellite): the
+        # autoscaler's split input, scrapeable
+        for role, depth in sorted(router.queue_depth_by_role().items()):
+            yield ("fleet_queue_depth", "gauge",
+                   "router-side in-flight requests by replica role",
+                   {"role": role}, float(depth))
         for r in router.replicas():
             labels = {"replica": r.name}
             with r.lock:

@@ -105,6 +105,33 @@ latency the prefill/decode split exists to protect.  Per-request
 `session_id`s feed sticky-session accounting (`session_affinity_hits`)
 whether or not a fleet router is in front.
 
+**Overload survival** (ISSUE-15, `serving/pressure.py`): every request
+carries a `priority` (`interactive` > `batch` > `best_effort`, default
+interactive) and the admission queue is kept ordered by
+(priority, arrival) — one class degenerates to the historic FIFO.
+With `preempt=True` (paged KV), a higher-priority request that would
+otherwise wait on a dry `PagePool` PREEMPTS the lowest-priority active
+lane: its pages are gathered in one fixed-shape dispatch, serialized
+through the shipping wire frame (SHA-256 over the payload) into a
+bounded host-side `SwapStore` (LRU, byte-capped), its slot and pages
+freed, and the request requeued with its original arrival stamp.  On
+re-admission the lane restores through the same pending-install plane
+a shipped lane uses and resumes BYTE-IDENTICALLY — greedy and seeded
+sampling alike, because the `fold_in(seed, count)` automaton sees
+identical inputs — composing with speculation, radix prefix reuse and
+chunked prefill.  A victim whose swap state was evicted (typed
+`SwapEvictedError`) or corrupted (the SHA-256 check) recomputes from
+its prompt: deterministic decode makes even that path byte-identical,
+so the loss is visible only in the ledger and the trace.  With
+`brownout` on, a pool-pressure automaton (`BrownoutLadder`:
+pages-free + queue-depth signals, hysteresis both directions) degrades
+gracefully before shedding — 1: speculation off, 2: prefill ride-along
+width shrunk, 3: best_effort lanes preempted proactively, 4:
+best_effort admissions shed with Retry-After — never touching
+interactive until the ladder is exhausted; every transition is
+counted, traced and exposed (docs/robustness.md "The degradation
+ladder").
+
 Resilience contract (ISSUE-4, mirrors `batcher.MicroBatcher`): bounded
 admission (`max_queue_depth` -> `ServingOverloadError`), per-request
 deadlines shed at the admitter before a prompt ever occupies a slot
@@ -141,17 +168,31 @@ from deeplearning4j_tpu.obs.trace import (
 )
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.paged import PagePool, RadixPrefixCache
+from deeplearning4j_tpu.serving.pressure import (
+    BrownoutLadder,
+    PRIORITY_RANK,
+    PressureConfig,
+    RANK_BEST_EFFORT,
+    SwapEvictedError,
+    SwapStore,
+    normalize_priority,
+)
 from deeplearning4j_tpu.serving.resilience import (
     CircuitBreaker,
     CircuitOpenError,
     DeadlineExceededError,
+    ServingError,
+    ServingOverloadError,
     ServingUnavailableError,
     check_admission,
 )
 from deeplearning4j_tpu.serving.transfer import (
     PageExport,
+    PageShipError,
     check_compatible,
+    deserialize_export,
     model_signature,
+    serialize_export,
 )
 
 
@@ -184,7 +225,9 @@ class _LMRequest:
                  "result", "error", "enqueued", "deadline", "abandoned",
                  "request_id", "t_installed", "t_done", "prefix_matched",
                  "drafted", "accepted", "export", "export_result",
-                 "import_pages", "stream", "session_id", "t_first")
+                 "import_pages", "stream", "session_id", "t_first",
+                 "priority", "rank", "swap_key", "swap_restore",
+                 "swap_error", "stream_pushed", "preempted")
 
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
                  seed: int, deadline: Optional[float] = None,
@@ -212,6 +255,14 @@ class _LMRequest:
         self.stream = None                 # per-token queue (SSE leg)
         self.session_id: Optional[str] = None
         self.t_first: Optional[float] = None  # first-committed-token stamp
+        # overload survival (ISSUE-15)
+        self.priority = "interactive"      # admission class
+        self.rank = 0                      # PRIORITY_RANK[priority]
+        self.swap_key: Optional[str] = None   # SwapStore key while queued
+        self.swap_restore = False          # import_pages came from swap
+        self.swap_error: Optional[str] = None  # typed restore failure
+        self.stream_pushed = 0             # tokens already streamed
+        self.preempted = 0                 # times this lane was preempted
 
 
 class _Slot:
@@ -254,6 +305,8 @@ class ContinuousLMServer:
                  pages: Optional[int] = None, prefill_chunk: int = 8,
                  speculate: str = "off", draft_len: int = 4,
                  drafter=None, draft_model=None, ship: bool = False,
+                 preempt: bool = False, swap_bytes: int = 64 << 20,
+                 brownout=None,
                  tracer: Optional[TraceRecorder] = None,
                  registry: Optional[MetricsRegistry] = None):
         if slots < 1:
@@ -289,6 +342,14 @@ class ContinuousLMServer:
             raise ValueError(
                 f"ship=True requires kv='paged' (got kv={kv!r}): page "
                 f"shipping moves block-table pages")
+        if preempt and kv != "paged":
+            raise ValueError(
+                f"preempt=True requires kv='paged' (got kv={kv!r}): "
+                f"preemption swaps block-table pages out to the host")
+        if brownout and kv != "paged":
+            raise ValueError(
+                f"brownout requires kv='paged' (got kv={kv!r}): the "
+                f"ladder's signals are the paged pool's pressure")
         self.cfg = cfg
         self.params = params
         self.n_slots = int(slots)
@@ -350,6 +411,19 @@ class ContinuousLMServer:
         self._gather = None
         self._install = None
         self._pending_install: List[Dict] = []
+        # overload-survival plane (ISSUE-15): priority preemption with
+        # host swap-out, and the brownout degradation ladder.  All of
+        # it is worker-thread state mutated under self._cond (the same
+        # single-mutator discipline as the page pool).
+        self.preempt = bool(preempt)
+        self._swap = SwapStore(int(swap_bytes)) if self.preempt else None
+        self._swap_seq = 0
+        if brownout is None or brownout is False:
+            self._pressure = None
+        elif isinstance(brownout, PressureConfig):
+            self._pressure = BrownoutLadder(brownout)
+        else:
+            self._pressure = BrownoutLadder()
         self._sessions: "collections.OrderedDict[str, int]" = (
             collections.OrderedDict())
         self._session_capacity = 1024
@@ -389,7 +463,8 @@ class ContinuousLMServer:
                        deadline_s: Optional[float],
                        request_id: Optional[str],
                        session_id: Optional[str] = None,
-                       export: bool = False) -> _LMRequest:
+                       export: bool = False,
+                       priority: Optional[str] = None) -> _LMRequest:
         """Validate + construct one queue item — THE shared front half of
         `generate`/`generate_stream`/`prefill_export`/`admit_with_pages`.
         Export lanes are budgeted for their prefill pages only (they
@@ -422,25 +497,72 @@ class ContinuousLMServer:
         req.session_id = (str(session_id) if session_id is not None
                           else None)
         req.export = bool(export)
+        req.priority = normalize_priority(priority)
+        req.rank = PRIORITY_RANK[req.priority]
         return req
 
     def _enqueue(self, req: _LMRequest) -> None:
         """Admission under the pool lock: the shared gate, worker start,
-        queue append, and sticky-session accounting."""
+        priority-ordered queue insert, and sticky-session accounting.
+        The brownout ladder's last rung fires here: at level 4 a
+        best_effort admission is refused with 503 + Retry-After BEFORE
+        the shared gate's queue bound, so interactive (and batch)
+        traffic keeps the whole queue bound to itself while the pool
+        recovers.  A draining/stopped server is NOT accepting at all —
+        that outranks the shed, so clients get the typed
+        draining/unavailable error and fail over instead of retrying a
+        pool that will never admit again."""
         with self._cond:
-            check_admission(
-                accepting=self._accepting, breaker=self.breaker,
-                queue_depth=len(self._queue),
-                max_queue_depth=self.max_queue_depth,
-                metrics=self.metrics,
-                retry_after_s=self._retry_after_locked, what="LM")
+            if (self._accepting
+                    and self._pressure is not None
+                    and self._pressure.level >= 4
+                    and req.rank >= RANK_BEST_EFFORT):
+                self.metrics.record_rejected()
+                self.metrics.record_class("rejected", req.priority)
+                self.metrics.record_brownout_shed()
+                raise ServingOverloadError(
+                    "brownout level 4: best_effort admission shed "
+                    "while the KV pool recovers",
+                    retry_after_s=self._retry_after_locked())
+            try:
+                check_admission(
+                    accepting=self._accepting, breaker=self.breaker,
+                    queue_depth=len(self._queue),
+                    max_queue_depth=self.max_queue_depth,
+                    metrics=self.metrics,
+                    retry_after_s=self._retry_after_locked, what="LM")
+            except ServingError:
+                # the shared gate already counted the rejection; the
+                # per-class ledger rides along (ISSUE-15)
+                self.metrics.record_class("rejected", req.priority)
+                raise
             if not self._running:
                 self._start_locked()
             if req.session_id is not None:
                 self._note_session_locked(req.session_id)
-            self._queue.append(req)
+            self._queue_insert_locked(req)
             self.metrics.set_queue_depth(len(self._queue))
             self._cond.notify_all()
+
+    def _queue_insert_locked(self, req: _LMRequest) -> None:
+        """Priority-ordered insert: the queue is kept sorted by
+        (rank, enqueued) so `popleft` always yields the most important,
+        oldest request — one class degenerates to exactly the historic
+        FIFO.  A preempted request re-inserts with its ORIGINAL
+        enqueue stamp, so it lands ahead of later arrivals of its own
+        class instead of restarting at the back.  O(queue) insert; the
+        queue is bounded by `max_queue_depth`."""
+        key = (req.rank, req.enqueued)
+        i = len(self._queue)
+        while i > 0:
+            prev = self._queue[i - 1]
+            if (prev.rank, prev.enqueued) <= key:
+                break
+            i -= 1
+        if i == len(self._queue):
+            self._queue.append(req)
+        else:
+            self._queue.insert(i, req)
 
     def _note_session_locked(self, session_id: str) -> None:
         """Sticky-session accounting (ISSUE-14 satellite): a session_id
@@ -473,6 +595,8 @@ class ContinuousLMServer:
                 self._queue.remove(req)
                 self.metrics.set_queue_depth(len(self._queue))
                 self.metrics.record_shed()
+                self.metrics.record_class("shed", req.priority)
+                self._drop_swap_locked(req)
             except ValueError:
                 req.abandoned = True
                 # a request the worker already RESOLVED needs no shed
@@ -510,7 +634,8 @@ class ContinuousLMServer:
                  timeout: Optional[float] = None,
                  deadline_s: Optional[float] = None,
                  request_id: Optional[str] = None,
-                 session_id: Optional[str] = None) -> List[int]:
+                 session_id: Optional[str] = None,
+                 priority: Optional[str] = None) -> List[int]:
         """prompt ids -> full sequence (prompt + generated), blocking.
 
         `timeout` bounds the client's wait; `deadline_s` (default
@@ -518,10 +643,14 @@ class ContinuousLMServer:
         the request once it expires instead of spending decode steps on
         a client that already gave up.  `request_id` names the request's
         trace (``X-Request-Id``); `session_id` feeds sticky-session
-        affinity accounting."""
+        affinity accounting.  `priority` (interactive/batch/best_effort,
+        default interactive) orders admission and marks the lane's
+        preemption class (docs/robustness.md "The degradation
+        ladder")."""
         req = self._build_request(prompt_ids, max_new_tokens, temperature,
                                   seed, deadline_s, request_id,
-                                  session_id=session_id)
+                                  session_id=session_id,
+                                  priority=priority)
         self._enqueue(req)
         return self._wait(req, timeout)
 
@@ -530,7 +659,8 @@ class ContinuousLMServer:
                         timeout: Optional[float] = None,
                         deadline_s: Optional[float] = None,
                         request_id: Optional[str] = None,
-                        session_id: Optional[str] = None
+                        session_id: Optional[str] = None,
+                        priority: Optional[str] = None
                         ) -> Iterator[int]:
         """Streaming `generate`: admission happens HERE (typed errors
         raise before a single byte of response is committed), then the
@@ -542,7 +672,8 @@ class ContinuousLMServer:
         nobody.  The full sequence is `prompt + every yielded token`."""
         req = self._build_request(prompt_ids, max_new_tokens, temperature,
                                   seed, deadline_s, request_id,
-                                  session_id=session_id)
+                                  session_id=session_id,
+                                  priority=priority)
         req.stream = _queue.SimpleQueue()
         self._enqueue(req)
         return self._stream_tokens(req, timeout)
@@ -611,7 +742,8 @@ class ContinuousLMServer:
                        timeout: Optional[float] = None,
                        deadline_s: Optional[float] = None,
                        request_id: Optional[str] = None,
-                       session_id: Optional[str] = None) -> PageExport:
+                       session_id: Optional[str] = None,
+                       priority: Optional[str] = None) -> PageExport:
         """Prefill-worker half of disaggregation: run the prompt through
         normal admission (radix reuse, chunked prefill, CoW) but resolve
         at prefill completion with the lane's shippable state — prompt
@@ -624,7 +756,8 @@ class ContinuousLMServer:
         self._require_ship("export")
         req = self._build_request(prompt_ids, max_new_tokens, temperature,
                                   seed, deadline_s, request_id,
-                                  session_id=session_id, export=True)
+                                  session_id=session_id, export=True,
+                                  priority=priority)
         self._enqueue(req)
         self._wait(req, timeout)
         return req.export_result
@@ -655,7 +788,8 @@ class ContinuousLMServer:
         req = self._build_request(export.prompt, export.max_new,
                                   export.temperature, export.seed,
                                   deadline_s, request_id,
-                                  session_id=export.session_id)
+                                  session_id=export.session_id,
+                                  priority=export.priority)
         req.import_pages = export
         self._enqueue(req)
         return self._wait(req, timeout)
@@ -679,7 +813,9 @@ class ContinuousLMServer:
                            if req.result else 0),
                 prefix_matched=req.prefix_matched or None,
                 drafted=req.drafted or None,
-                accepted=(req.accepted if req.drafted else None)))
+                accepted=(req.accepted if req.drafted else None),
+                preempted=req.preempted or None,
+                swap_error=req.swap_error))
             if self._compile_watch.any_since(req.t_installed):
                 for c_end, c_dur, key in (self._compile_watch
                                           .events_between(req.t_installed,
@@ -760,8 +896,8 @@ class ContinuousLMServer:
         with compile_scope("lm:page_copy"):
             k, v = self._copy(*self._cache, np.int32(0), np.int32(0))
         self._cache = (k, v)
-        if self.ship:
-            # the shipping pair: a gather out of the live pool (not
+        if self.ship or self.preempt:
+            # the shipping/swap pair: a gather out of the live pool (not
             # donated — the row of nulls reads only the null page) and
             # an n=0 install whose every row lands on the null page
             zrow = np.zeros((self.max_pages,), np.int32)
@@ -778,7 +914,9 @@ class ContinuousLMServer:
     def compiled_programs(self) -> int:
         if self.kv == "dense":
             return 1
-        ship = 2 if self.ship else 0   # page gather + batched install
+        # page gather + batched install serve BOTH the shipping wire
+        # plane and preemption swap-out/restore — one compiled pair
+        ship = 2 if (self.ship or self.preempt) else 0
         if self.speculate != "off":
             # 1-wide decode + the shared prefill/verify wide program +
             # page copy, plus whatever the drafter runs on device
@@ -802,6 +940,7 @@ class ContinuousLMServer:
             self.metrics.set_queue_depth(0)
         for req in leftovers:
             self.metrics.record_shed()
+            self.metrics.record_class("shed", req.priority)
             req.error = ServingUnavailableError("LM server stopped")
             req.event.set()
 
@@ -893,6 +1032,13 @@ class ContinuousLMServer:
             if self._sessions:
                 out["sessions_tracked"] = len(self._sessions)
             out["kv"] = kv
+            if self.preempt or self._pressure is not None:
+                pres: Dict = {"preempt": self.preempt}
+                if self._swap is not None:
+                    pres["swap"] = self._swap.stats()
+                if self._pressure is not None:
+                    pres["brownout"] = self._pressure.stats()
+                out["pressure"] = pres
             if self.speculate != "off":
                 spec = {"mode": self.speculate,
                         "draft_len": self.draft_len,
@@ -965,6 +1111,14 @@ class ContinuousLMServer:
             # exist; its own cache self-heals via the common-prefix
             # rewind, but the bookkeeping must not outlive the pool
             self._drafter.reset()
+        if self._swap is not None:
+            # swapped blobs are self-contained host copies and would
+            # stay VALID across a device pool rebuild, but the reset
+            # paths either fail every request that could restore them
+            # (stop) or want one coherent story (failed dispatch):
+            # clear, and let any surviving queued victim take the
+            # recompute-from-prompt path — byte-identical either way
+            self._swap.clear()
         self.metrics.set_pages(0, self.kv_pages, self.kv_pages)
 
     def _start_locked(self) -> None:
@@ -998,7 +1152,7 @@ class ContinuousLMServer:
                         if self.prefill_chunk > 1 else None)
                 self._copy = make_page_copy(self.cfg, total,
                                             self.page_size)
-                if self.ship:
+                if self.ship or self.preempt:
                     from deeplearning4j_tpu.parallel.generation import (
                         make_page_gather,
                         make_page_install,
@@ -1070,12 +1224,44 @@ class ContinuousLMServer:
         slot.table = None
         slot.inserted = False
 
+    def _resolve_swap_locked(self, req: _LMRequest) -> None:
+        """Turn a requeued victim's swap key into an installable
+        shipment.  A key whose blob was evicted (`SwapEvictedError`) or
+        fails the wire frame's SHA-256/geometry checks (`PageShipError`)
+        is the typed swap-loss path: the loss is counted, stamped on
+        the victim request's trace, and the lane falls back to
+        recomputing from its prompt — deterministic decode makes the
+        recomputed tokens byte-identical, so the CLIENT never sees the
+        error, only the accounting and the trace do."""
+        key, req.swap_key = req.swap_key, None
+        try:
+            blob = self._swap.take(key)
+        except SwapEvictedError as e:
+            self.metrics.record_swap_lost("evicted")
+            req.swap_error = f"{type(e).__name__}: {e}"
+            return
+        try:
+            ex = deserialize_export(blob)
+            check_compatible(ex, self.cfg, self.page_size,
+                             mid_decode=True)
+        except PageShipError as e:
+            self.metrics.record_swap_lost("corrupt")
+            req.swap_error = f"{type(e).__name__}: {e}"
+            return
+        req.import_pages = ex
+        req.swap_restore = True
+
     def _plan_admission_paged(self, req: _LMRequest):
         """Radix-match + allocate for one queued request.  Returns the
         install plan, or None when the pool (after eviction) cannot
         supply the fresh pages — the request stays queued, FIFO.  Every
         page the plan references is already retained."""
         plen = len(req.prompt)
+        if req.swap_key is not None and self._swap is not None:
+            # a preempted lane coming back: resolve its host swap into
+            # the same install plane a shipped lane uses (or fall back
+            # to recompute-from-prompt when the state is gone/corrupt)
+            self._resolve_swap_locked(req)
         if req.import_pages is not None:
             # shipped-in lane (ISSUE-14): FULL prefix pages this pool's
             # radix tree already holds are reused instead of installing
@@ -1182,8 +1368,8 @@ class ContinuousLMServer:
             irow = row.copy()
             irow[:len(plan["full"])] = 0
             self._pending_install.append(
-                {"pk": pk, "pv": pv, "row": irow,
-                 "n": n_ship, "nbytes": ex.nbytes()})
+                {"pk": pk, "pv": pv, "row": irow, "n": n_ship,
+                 "nbytes": ex.nbytes(), "swap": req.swap_restore})
             self.metrics.record_prefix_query(plan["matched"])
             n_full_prompt = len(req.prompt) // self.page_size
             if n_full_prompt:
@@ -1194,9 +1380,13 @@ class ContinuousLMServer:
             # the shipment's committed tokens ARE this lane's first
             # tokens: stamp TTFT at install (the prefill worker already
             # paid the first-token latency; this pool's number says how
-            # long the shipment sat in its queue)
-            req.t_first = req.t_installed
-            self.metrics.record_first_token(req.t_first - req.enqueued)
+            # long the shipment sat in its queue).  A PREEMPTED lane
+            # restoring from swap already stamped its true first token
+            # before the preemption — never re-stamp it.
+            if req.t_first is None:
+                req.t_first = req.t_installed
+                self.metrics.record_first_token(
+                    req.t_first - req.enqueued)
             return
         if plan["partial"] is not None:
             # copy-on-write: the divergence page's matched tokens are
@@ -1214,13 +1404,22 @@ class ContinuousLMServer:
         an abandoned request's slot (and pages) is freed, and an expired
         or abandoned queue item must never occupy a slot.  The queue
         sweep is one rebuild pass — per-item `deque.remove` would be
-        O(n^2) under exactly the overload storm it exists for.  Paged
-        admission is FIFO: when the head request's pages cannot be
-        supplied even after eviction, admission stops rather than
-        letting smaller later requests starve it forever."""
+        O(n^2) under exactly the overload storm it exists for.
+
+        Paged admission is priority-then-FIFO (ISSUE-15): the queue is
+        kept sorted by (rank, enqueued), so the head is the most
+        important oldest request.  When the head's pages cannot be
+        supplied even after eviction, admission PREEMPTS the
+        lowest-priority active lane (strictly outranked by the head;
+        its state swaps out to the host store) before giving up and
+        waiting — so a latency class never starves behind a long
+        low-value lane.  With preemption off (or no outranked victim)
+        the historic head-of-line wait is unchanged: admission stops
+        rather than letting smaller later requests starve the head."""
         for slot in self._slots:
             if slot.active and slot.req.abandoned:
                 self.metrics.record_shed()
+                self.metrics.record_class("shed", slot.req.priority)
                 self._free_slot_pages(slot)
                 slot.req = None
         now = time.perf_counter()
@@ -1228,9 +1427,15 @@ class ContinuousLMServer:
         for req in self._queue:
             if req.abandoned:
                 shed += 1
+                self.metrics.record_class("shed", req.priority)
+                self._drop_swap_locked(req)
             elif req.deadline is not None and now >= req.deadline:
                 shed += 1
                 self.metrics.record_deadline_missed()
+                self.metrics.record_class("shed", req.priority)
+                self.metrics.record_class("deadline_missed",
+                                          req.priority)
+                self._drop_swap_locked(req)
                 req.error = DeadlineExceededError(
                     f"deadline exceeded after {now - req.enqueued:.3f}s "
                     f"in LM queue; shed before decode")
@@ -1240,13 +1445,17 @@ class ContinuousLMServer:
         if shed:
             self._queue = kept
             self.metrics.record_shed(shed)
+        self._update_pressure_locked()
         for slot in self._slots:
             if not self._queue:
                 break
             if slot.active:
                 continue
             if self.kv == "paged":
-                plan = self._plan_admission_paged(self._queue[0])
+                head = self._queue[0]
+                plan = self._plan_admission_paged(head)
+                while plan is None and self._preempt_one_locked(head):
+                    plan = self._plan_admission_paged(head)
                 if plan is None:
                     break              # head-of-line waits for pages
                 req = self._queue.popleft()
@@ -1262,6 +1471,120 @@ class ContinuousLMServer:
             self.metrics.set_pages(self._pool.in_use, self._pool.free,
                                    self.kv_pages)
 
+    def _drop_swap_locked(self, req: _LMRequest) -> None:
+        """A shed/abandoned queue item releases its host swap bytes."""
+        if req.swap_key is not None and self._swap is not None:
+            self._swap.discard(req.swap_key)
+            req.swap_key = None
+
+    def _update_pressure_locked(self) -> None:
+        """One brownout-ladder reading per admission round: pool
+        pages-free + queue depth in, level out; every transition is
+        counted and published (ISSUE-15).  Ladder level 3 additionally
+        preempts best_effort lanes PROACTIVELY — before the pool is
+        fully dry — whenever strictly higher-class work is waiting."""
+        if self._pressure is None or self._pool is None:
+            return
+        # pages-free counts evictable radix-cached pages too: a warm
+        # prefix cache is reclaimable capacity, not pressure — without
+        # this an idle pool with a full cache would sit degraded forever
+        cfg = self._pressure.config
+        avail = self._pool.free
+        if (self._tree is not None
+                and avail / max(1, self.kv_pages)
+                <= cfg.enter_free_frac[0] + cfg.exit_free_margin):
+            # evictable() is an O(cache) tree walk under the pool lock,
+            # once per admission round: skip it when free pages alone
+            # clear the shallowest enter threshold plus the exit margin
+            # — adding reclaimable capacity on top cannot change the
+            # ladder's reading there (every enter_free_frac[k] and
+            # every calm bound is <= this line)
+            avail += self._tree.evictable()
+        moves = self._pressure.update(avail, self.kv_pages,
+                                      len(self._queue), self.n_slots)
+        self.metrics.record_brownout(self._pressure.level, len(moves))
+        if (self._pressure.level >= 3 and self.preempt and self._queue
+                and self._queue[0].rank < RANK_BEST_EFFORT):
+            head_rank = self._queue[0].rank
+            for slot in self._slots:
+                if (slot.active and not slot.req.abandoned
+                        and slot.req.rank >= RANK_BEST_EFFORT
+                        and slot.req.rank > head_rank):
+                    self._preempt_slot_locked(slot)
+
+    def _preempt_one_locked(self, head: _LMRequest) -> bool:
+        """Pick and preempt ONE victim so `head` can admit: the active
+        lane with the worst (highest) rank strictly above the head's,
+        ties broken newest-first so older work of the same class keeps
+        its progress.  Returns False when preemption is off, no program
+        pair exists yet, or nothing outranked is running."""
+        if not self.preempt or self._gather is None or self._cache is None:
+            return False
+        victims = [s for s in self._slots
+                   if s.active and not s.req.abandoned
+                   and s.req.rank > head.rank]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda s: (s.req.rank, s.req.enqueued))
+        self._preempt_slot_locked(victim)
+        return True
+
+    def _preempt_slot_locked(self, slot: _Slot) -> None:
+        """Evict one active lane in favor of higher-priority work.
+
+        A lane mid-decode swaps its KV state out to the host: one
+        fixed-shape gather dispatch, then the same serialized wire
+        frame the shipping plane uses (SHA-256 over the payload), into
+        the byte-capped LRU `SwapStore`.  On re-admission it restores
+        through the pending-install plane and resumes byte-identically
+        — decode is deterministic (greedy and `fold_in(seed, count)`
+        sampling), so even a lane whose swap is later lost recomputes
+        the SAME tokens from its prompt.  A lane still mid-prefill (or
+        an export lane) has nothing worth shipping: it just requeues
+        and re-prefills (radix-cached pages make that cheap).  Either
+        way the request keeps its original enqueue stamp, so it
+        re-enters AHEAD of later arrivals of its own class."""
+        req = slot.req
+        mid_decode = (slot.fed >= len(req.prompt) and slot.generated
+                      and not req.export)
+        if (mid_decode and self._swap is not None
+                and self._gather is not None and self._cache is not None):
+            n = -(-slot.pos // self.page_size)
+            with compile_scope("lm:page_gather"):
+                pk, pv = self._gather(*self._cache, slot.table)
+            pk = np.asarray(pk)[:, :n]
+            pv = np.asarray(pv)[:, :n]
+            ex = PageExport(
+                prompt=list(req.prompt), max_new=req.max_new,
+                temperature=req.temperature, seed=req.seed,
+                committed=list(slot.generated), pos=int(slot.pos),
+                page_size=self.page_size, pages_k=pk, pages_v=pv,
+                model=model_signature(self.cfg, self.page_size),
+                session_id=req.session_id, priority=req.priority)
+            blob = serialize_export(ex)
+            key = f"swap-{self._swap_seq}"
+            self._swap_seq += 1
+            evicted = self._swap.put(key, blob)
+            if evicted is None:
+                # the blob alone exceeds the cap: recompute-from-prompt
+                # on re-admission instead of wiping every other victim
+                self.metrics.record_swap_lost("evicted")
+            else:
+                req.swap_key = key
+                # raw array bytes, matching the swap-in site and the
+                # ship ledger — a lossless round trip reads out == in
+                self.metrics.record_swap("out", n, ex.nbytes())
+                # LRU victims whose state just got dropped recompute
+                # from their prompts at restore time — where the loss
+                # is counted (once), by _resolve_swap_locked
+        req.preempted += 1
+        self.metrics.record_preemption(req.priority)
+        self._free_slot_pages(slot)
+        slot.req = None
+        slot.generated = []
+        self._queue_insert_locked(req)
+        self.metrics.set_queue_depth(len(self._queue))
+
     def _finish_slot(self, slot: _Slot) -> None:
         """Completion fold: resolve the client, free the lane + pages."""
         if slot.req.abandoned:
@@ -1269,7 +1592,9 @@ class ContinuousLMServer:
             # DeadlineExceededError: the finished sequence is
             # discarded work, not a served request
             self.metrics.record_shed()
+            self.metrics.record_class("shed", slot.req.priority)
         else:
+            self.metrics.record_class("requests", slot.req.priority)
             slot.req.result = slot.req.prompt + slot.generated
             now = time.perf_counter()
             slot.req.t_done = now
@@ -1326,6 +1651,11 @@ class ContinuousLMServer:
                 return False
             cow, self._pending_cow = self._pending_cow, []
             installs, self._pending_install = self._pending_install, []
+            # the brownout level this round dispatches under — read
+            # once with the lock held; the ladder only moves inside
+            # _admit_locked, so the level cannot change mid-dispatch
+            level = (self._pressure.level if self._pressure is not None
+                     else 0)
         if self.breaker is not None and not self.breaker.allow_dispatch():
             # open breaker: fast-fail whatever is in flight rather than
             # burning decode steps on a failing device
@@ -1340,6 +1670,8 @@ class ContinuousLMServer:
                 for s in self._slots:
                     if s.active:
                         self.metrics.record_shed()
+                        self.metrics.record_class("shed",
+                                                  s.req.priority)
                         s.req.error = err
                         s.req.event.set()
                         self._free_slot_pages(s)
@@ -1353,7 +1685,7 @@ class ContinuousLMServer:
             # fault handler — slots restart at pos 0, nothing to keep)
             self._reset_cache()
         if self.kv == "paged":
-            return self._dispatch_paged(active, cow, installs)
+            return self._dispatch_paged(active, cow, installs, level)
         return self._dispatch_dense(active)
 
     def _dispatch_dense(self, active) -> bool:
@@ -1442,15 +1774,25 @@ class ContinuousLMServer:
         """Fold newly committed tokens into a lane: first-token TTFT
         stamp, the lane's generated list, and the request's stream (one
         push per token — a speculative round's multi-token commit
-        streams as individual events)."""
+        streams as individual events).  The stream cursor is the
+        COUNT of tokens already pushed, not "everything new": a
+        preempted lane whose swap state was lost recomputes its early
+        tokens from the prompt, and those regenerated (byte-identical)
+        tokens must not stream twice."""
         req = slot.req
         if req.t_first is None:
             req.t_first = time.perf_counter()
             self.metrics.record_first_token(req.t_first - req.enqueued)
         slot.generated.extend(toks)
         if req.stream is not None and not req.abandoned:
-            for t in toks:
+            # monotonic cursor: a recompute rebuilding the early tokens
+            # stays BELOW the cursor until it passes where the stream
+            # left off — never rewind it, or the rebuilt (identical)
+            # tokens would stream again
+            for t in slot.generated[req.stream_pushed:]:
                 req.stream.put(int(t))
+            req.stream_pushed = max(req.stream_pushed,
+                                    len(slot.generated))
 
     def _export_slot(self, slot: _Slot) -> None:
         """Prefill just completed on an export lane: gather its pages
@@ -1472,13 +1814,14 @@ class ContinuousLMServer:
             committed=list(slot.generated), pos=int(slot.pos),
             page_size=self.page_size, pages_k=pk, pages_v=pv,
             model=model_signature(self.cfg, self.page_size),
-            session_id=req.session_id)
+            session_id=req.session_id, priority=req.priority)
         self.metrics.record_ship("out", n, ex.nbytes(),
                                  time.perf_counter() - t0)
         req.export_result = ex
         self._finish_slot(slot)
 
-    def _dispatch_paged(self, active, cow, installs) -> bool:
+    def _dispatch_paged(self, active, cow, installs,
+                        level: int = 0) -> bool:
         # land shipped-in pages first (their lane's committed state is
         # already live — its next feed reads them), then pending
         # copy-on-write pages: a CoW admitted in the same round may
@@ -1490,16 +1833,31 @@ class ContinuousLMServer:
                                      item["pv"], item["row"],
                                      np.int32(item["n"]))
             self._cache = (k, v)
-            self.metrics.record_ship("in", item["n"], item["nbytes"],
-                                     time.perf_counter() - t0)
+            if item.get("swap"):
+                # a preempted lane restoring from the host store — the
+                # swap ledger, not the wire-shipping one
+                self.metrics.record_swap("in", item["n"],
+                                         item["nbytes"])
+            else:
+                self.metrics.record_ship("in", item["n"],
+                                         item["nbytes"],
+                                         time.perf_counter() - t0)
         for item in cow:
             with compile_scope("lm:page_copy"):
                 k, v = self._copy(*self._cache, np.int32(item["src"]),
                                   np.int32(item["dst"]))
             self._cache = (k, v)
             self._pool.release([item["src"]])
+        # brownout ladder effects (ISSUE-15, docs/robustness.md "The
+        # degradation ladder"): level 1 turns speculation off (drafts
+        # buy throughput with wide-dispatch compute — under pressure
+        # that compute belongs to survival); level 2 additionally
+        # shrinks the prefill ride-along width so active decode lanes
+        # commit more often while admission throughput pays.
         drafts = (self._draft_proposals()
-                  if self._drafter is not None else {})
+                  if self._drafter is not None and level < 1 else {})
+        chunk_eff = (max(1, self.prefill_chunk // 2) if level >= 2
+                     else self.prefill_chunk)
         # chunk width: the wide program dispatches only while some lane
         # has a FULL chunk of prompt left to feed — sub-chunk tails and
         # pure-decode rounds ride the 1-wide program — or, with
@@ -1509,7 +1867,7 @@ class ContinuousLMServer:
         # never compiles (or pays for) the wide program at all; a long
         # prompt costs ceil(P/chunk) wide dispatches plus its tail.
         width = 1
-        full_chunk = any(len(s.req.prompt) - s.fed >= self.prefill_chunk
+        full_chunk = any(len(s.req.prompt) - s.fed >= chunk_eff
                          for s in active)
         if self.speculate != "off":
             if drafts or (full_chunk and self.prefill_chunk > 1):
@@ -1530,7 +1888,7 @@ class ContinuousLMServer:
             req = slot.req
             remaining = len(req.prompt) - slot.fed
             if remaining > 0:                  # chunked prefill
-                f = min(remaining, width, self.prefill_chunk)
+                f = min(remaining, width, chunk_eff)
                 tokens[i, :f] = req.prompt[slot.fed:slot.fed + f]
                 n_feed[i] = f
             elif width > 1 and i in drafts:    # speculative verify
@@ -1637,6 +1995,7 @@ class ContinuousLMServer:
                         self._warm_req = None
                     for r in victims:
                         self.metrics.record_shed()
+                        self.metrics.record_class("shed", r.priority)
                         r.error = ServingUnavailableError(
                             "LM server stopped")
                         r.event.set()

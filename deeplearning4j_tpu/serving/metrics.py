@@ -32,6 +32,12 @@ from deeplearning4j_tpu.obs.registry import (
     MetricsRegistry,
 )
 from deeplearning4j_tpu.runtime.profiler import LatencyRecorder
+from deeplearning4j_tpu.serving.pressure import PRIORITY_CLASSES
+
+# the per-class resilience events snapshot()/exposition break out —
+# the existing deadline/shed/breaker discipline, preserved per class
+_CLASS_EVENTS = ("requests", "rejected", "shed", "deadline_missed",
+                 "preempted")
 
 # breaker state -> gauge value (the exposition's numeric encoding;
 # the string stays in /serving/stats)
@@ -148,6 +154,45 @@ class ServingMetrics:
             "serving_session_affinity_hits_total",
             "session_id requests that landed on a pool that had "
             "already served the session")
+        # overload-survival ledger (ISSUE-15): priority classes,
+        # preemption with host swap-out, and the brownout ladder
+        self.class_counters = {
+            (event, cls): Counter(
+                f"serving_lm_class_{event}_total",
+                f"LM {event} by priority class")
+            for event in _CLASS_EVENTS for cls in PRIORITY_CLASSES}
+        self.preemptions_total = Counter(
+            "serving_lm_preemptions_total",
+            "lanes preempted so higher-priority work could admit")
+        self.swap_out_total = Counter(
+            "serving_kv_swap_out_total",
+            "preempted lanes swapped out to the host store")
+        self.swap_in_total = Counter(
+            "serving_kv_swap_in_total",
+            "preempted lanes restored from the host store")
+        self.swap_pages_total = Counter(
+            "serving_kv_swap_pages_total",
+            "KV pages moved through host swap (both directions)")
+        self.swap_bytes_total = Counter(
+            "serving_kv_swap_bytes_total",
+            "serialized bytes moved through host swap")
+        self.swap_evicted_total = Counter(
+            "serving_kv_swap_evicted_total",
+            "swapped lanes whose state the byte-capped store dropped "
+            "(restore recomputes from the prompt)")
+        self.swap_corrupt_total = Counter(
+            "serving_kv_swap_corrupt_total",
+            "swapped lanes whose state failed the SHA-256 restore "
+            "check (restore recomputes from the prompt)")
+        self.brownout_level_gauge = Gauge(
+            "serving_brownout_level",
+            "degradation-ladder level (0 healthy .. 4 shedding)")
+        self.brownout_transitions_total = Counter(
+            "serving_brownout_transitions_total",
+            "degradation-ladder level changes (both directions)")
+        self.brownout_shed_total = Counter(
+            "serving_brownout_shed_total",
+            "best_effort admissions refused by ladder level 4")
         # latency: end-to-end histogram + the queue-wait vs
         # dispatch-compute split (ISSUE-8 satellite — the batcher knows
         # both timestamps; before this they were collapsed into one
@@ -189,9 +234,17 @@ class ServingMetrics:
                   self.ship_hist, self.ttft_hist,
                   self.session_queries_total,
                   self.session_affinity_hits_total,
+                  self.preemptions_total, self.swap_out_total,
+                  self.swap_in_total, self.swap_pages_total,
+                  self.swap_bytes_total, self.swap_evicted_total,
+                  self.swap_corrupt_total, self.brownout_level_gauge,
+                  self.brownout_transitions_total,
+                  self.brownout_shed_total,
                   self.latency_hist, self.queue_wait_hist,
                   self.compute_hist):
             registry.register(m, **labels)
+        for (_event, cls), m in self.class_counters.items():
+            registry.register(m, priority=cls, **labels)
         return self
 
     # ---- recording --------------------------------------------------------
@@ -284,6 +337,59 @@ class ServingMetrics:
         self.pages_shipped_total.inc(int(pages))
         self.ship_bytes_total.inc(int(nbytes))
         self.ship_hist.observe(max(0.0, float(seconds)))
+
+    def record_class(self, event: str, priority: str,
+                     n: int = 1) -> None:
+        """Per-priority-class resilience accounting (ISSUE-15): `event`
+        is one of requests/rejected/shed/deadline_missed.  An unknown
+        class is counted as interactive rather than raised — the typed
+        validation already happened at admission; accounting must
+        never fail a request."""
+        key = (event, priority if priority in PRIORITY_CLASSES
+               else PRIORITY_CLASSES[0])
+        counter = self.class_counters.get(key)
+        if counter is not None:
+            counter.inc(int(n))
+
+    def record_preemption(self, priority: str) -> None:
+        """One lane preempted (its class is the victim's — the
+        per-class row is how an operator verifies ladder level 3
+        only ever preempts best_effort)."""
+        self._touch()
+        self.preemptions_total.inc()
+        self.record_class("preempted", priority)
+
+    def record_swap(self, direction: str, pages: int,
+                    nbytes: int) -> None:
+        """One lane swapped 'out' to (or restored 'in' from) the host
+        store — the preemption analog of `record_ship`."""
+        self._touch()
+        (self.swap_out_total if direction == "out"
+         else self.swap_in_total).inc()
+        self.swap_pages_total.inc(int(pages))
+        self.swap_bytes_total.inc(int(nbytes))
+
+    def record_swap_lost(self, kind: str) -> None:
+        """A swapped lane's state was unusable at restore: `kind` is
+        'evicted' (byte-cap LRU dropped it) or 'corrupt' (SHA-256 or
+        frame check failed).  Either way the lane recomputes from its
+        prompt — deterministic decode keeps the output byte-identical,
+        so only this ledger ever sees the loss."""
+        self._touch()
+        (self.swap_corrupt_total if kind == "corrupt"
+         else self.swap_evicted_total).inc()
+
+    def record_brownout(self, level: int, transitions: int = 0) -> None:
+        """Publish the current ladder level; `transitions` new level
+        changes since the last call (counted, per the ISSUE-15
+        every-transition-counted contract)."""
+        self.brownout_level_gauge.set(int(level))
+        if transitions:
+            self.brownout_transitions_total.inc(int(transitions))
+
+    def record_brownout_shed(self) -> None:
+        self._touch()
+        self.brownout_shed_total.inc()
 
     def record_first_token(self, seconds: float) -> None:
         """Time-to-first-token for one request: admission to the first
@@ -399,6 +505,37 @@ class ServingMetrics:
             out["session_queries"] = sq
             out["session_affinity_hits"] = int(
                 self.session_affinity_hits_total.value)
+        # overload-survival sections (ISSUE-15), present only once the
+        # plane has actually fired so pre-existing snapshots are stable
+        classes = {}
+        for cls in PRIORITY_CLASSES:
+            vals = {e: int(self.class_counters[(e, cls)].value)
+                    for e in _CLASS_EVENTS}
+            if any(vals.values()):
+                classes[cls] = vals
+        if classes:
+            out["priority"] = classes
+        if int(self.preemptions_total.value):
+            out["preemptions"] = int(self.preemptions_total.value)
+        swaps = (int(self.swap_out_total.value)
+                 + int(self.swap_in_total.value)
+                 + int(self.swap_evicted_total.value)
+                 + int(self.swap_corrupt_total.value))
+        if swaps:
+            out["swap"] = {
+                "out": int(self.swap_out_total.value),
+                "in": int(self.swap_in_total.value),
+                "pages": int(self.swap_pages_total.value),
+                "bytes": int(self.swap_bytes_total.value),
+                "evicted": int(self.swap_evicted_total.value),
+                "corrupt": int(self.swap_corrupt_total.value)}
+        if (int(self.brownout_transitions_total.value)
+                or int(self.brownout_level_gauge.value)):
+            out["brownout"] = {
+                "level": int(self.brownout_level_gauge.value),
+                "transitions": int(
+                    self.brownout_transitions_total.value),
+                "shed": int(self.brownout_shed_total.value)}
         if pq:
             out["prefix_queries"] = pq
             out["prefix_hits"] = int(self.prefix_hits_total.value)

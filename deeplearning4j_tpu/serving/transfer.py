@@ -93,6 +93,10 @@ class PageExport:
     pages_v: np.ndarray
     model: Dict                 # model_signature of the exporting pool
     session_id: Optional[str] = None
+    # admission class (ISSUE-15): rides the frame so a shipped or
+    # swapped lane keeps its priority on the pool it lands in; absent
+    # in pre-ISSUE-15 frames -> interactive (the historical behavior)
+    priority: str = "interactive"
 
     @property
     def n_pages(self) -> int:
@@ -128,6 +132,8 @@ def serialize_export(ex: PageExport) -> bytes:
     }
     if ex.session_id is not None:
         header["session_id"] = str(ex.session_id)
+    if ex.priority != "interactive":
+        header["priority"] = str(ex.priority)
     hj = json.dumps(header).encode()
     return MAGIC + struct.pack(">I", len(hj)) + hj + payload
 
@@ -188,14 +194,21 @@ def deserialize_export(data: bytes) -> PageExport:
         pos=int(header["pos"]),
         page_size=int(header["page_size"]),
         pages_k=pk, pages_v=pv, model=dict(header["model"]),
-        session_id=header.get("session_id"))
+        session_id=header.get("session_id"),
+        priority=str(header.get("priority", "interactive")))
 
 
-def check_compatible(ex: PageExport, cfg, page_size: int) -> None:
+def check_compatible(ex: PageExport, cfg, page_size: int,
+                     mid_decode: bool = False) -> None:
     """The import gate: shipped geometry must equal the importing
     pool's, field for field — a page stack cut for different
     layers/heads/dtype/page-size would install as silent garbage.
-    Raises `PageShipError` naming every mismatched field."""
+    Raises `PageShipError` naming every mismatched field.
+
+    ``mid_decode`` relaxes the prefill-boundary invariant for the
+    overload-survival plane (ISSUE-15): a PREEMPTED lane swaps out
+    mid-decode, so its ``pos`` sits anywhere past the prompt — but the
+    page-count and committed-token invariants still hold exactly."""
     local = model_signature(cfg, page_size)
     bad = [f"{k}: shipped {ex.model.get(k)!r} != local {v!r}"
            for k, v in local.items() if ex.model.get(k) != v]
@@ -208,7 +221,12 @@ def check_compatible(ex: PageExport, cfg, page_size: int) -> None:
         raise PageShipError(
             f"shipment page stack {tuple(ex.pages_k.shape)} != "
             f"{want} for this pool's geometry")
-    if ex.pos != len(ex.prompt):
+    if mid_decode:
+        if ex.pos < len(ex.prompt):
+            raise PageShipError(
+                f"swapped lane pos {ex.pos} < prompt length "
+                f"{len(ex.prompt)}: only post-prefill lanes swap")
+    elif ex.pos != len(ex.prompt):
         raise PageShipError(
             f"shipment pos {ex.pos} != prompt length "
             f"{len(ex.prompt)}: only prefill-complete lanes ship")

@@ -497,6 +497,14 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
             deadline_s = self._deadline_s(body)
             session_id = self._session_id(body)
             stream = bool(body.get("stream", False))
+            # admission class (ISSUE-15): validated HERE so an unknown
+            # class is a 400 naming the vocabulary, never a silent
+            # default; accepted on every front — fleet or bare serve
+            from deeplearning4j_tpu.serving.pressure import (
+                normalize_priority,
+            )
+
+            priority = normalize_priority(body.get("priority"))
             ids_list = validate_request(cfg, prompt, max_new)
             if temperature < 0:
                 raise ValueError(f"temperature must be >= 0, "
@@ -565,7 +573,8 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
                 gen = lm_server.generate_stream(
                     ids_list, max_new, temperature=temperature,
                     seed=seed, deadline_s=deadline_s,
-                    request_id=self.request_id(), session_id=session_id)
+                    request_id=self.request_id(), session_id=session_id,
+                    priority=priority)
                 self._sse_stream(gen, ids_list)
                 return
             if (lm_server is not None and top_k == 0 and top_p >= 1.0):
@@ -575,7 +584,8 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
                                          temperature=temperature,
                                          seed=seed, deadline_s=deadline_s,
                                          request_id=self.request_id(),
-                                         session_id=session_id)
+                                         session_id=session_id,
+                                         priority=priority)
                 self._json(200, {"ids": ids})
                 return
             import jax
@@ -694,7 +704,8 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
                 seed=int(body.get("seed", 0)) & 0x7FFFFFFF,
                 deadline_s=self._deadline_s(body),
                 request_id=self.request_id(),
-                session_id=self._session_id(body))
+                session_id=self._session_id(body),
+                priority=body.get("priority"))
         except (ValueError, TypeError) as e:
             self._json(400, {"error": str(e)})
             return
@@ -778,7 +789,9 @@ class UiServer:
                  kv: str = "paged", page_size: int = 16,
                  pages: Optional[int] = None,
                  prefill_chunk: int = 8, speculate: str = "off",
-                 draft_len: int = 4, ship: bool = False) -> "UiServer":
+                 draft_len: int = 4, ship: bool = False,
+                 preempt: bool = False, swap_bytes: int = 64 << 20,
+                 brownout=None) -> "UiServer":
         """Register a TransformerLM for POST /lm/generate.  With
         `continuous` (default) greedy/temperature requests decode in a
         `slots`-lane continuous batching pool; `continuous=False` keeps
@@ -792,7 +805,11 @@ class UiServer:
         speculative multi-token decode for greedy lanes with up to
         `draft_len` drafts per round (paged KV only; sampling lanes
         fall back to 1-token decode — docs/performance.md "The
-        speculative decode cost model")."""
+        speculative decode cost model").  `preempt`/`swap_bytes` turn
+        on priority preemption with host KV swap-out and `brownout`
+        (True or a `PressureConfig`) the degradation ladder — the
+        overload-survival plane (docs/robustness.md "The degradation
+        ladder")."""
         lm_server = None
         if continuous:
             from deeplearning4j_tpu.serving import (
@@ -808,7 +825,8 @@ class UiServer:
                 default_deadline_s=default_deadline_s, breaker=breaker,
                 kv=kv, page_size=page_size, pages=pages,
                 prefill_chunk=prefill_chunk, speculate=speculate,
-                draft_len=draft_len, ship=ship,
+                draft_len=draft_len, ship=ship, preempt=preempt,
+                swap_bytes=swap_bytes, brownout=brownout,
                 tracer=self.state.tracer,
                 registry=self.state.registry)
         with self.state.lock:

@@ -76,6 +76,13 @@ def pytest_configure(config):
         "streaming incl. mid-stream disconnect hygiene (fast; run in "
         "tier-1)")
     config.addinivalue_line(
+        "markers", "pressure: overload-survival plane — priority "
+        "admission ordering, KV lane preemption with host swap-out "
+        "byte-parity, swap eviction/corruption recompute fallback, "
+        "brownout degradation ladder incl. hysteresis, pool-exhaustion "
+        "chaos regression, role-aware autoscale signals (fast; run in "
+        "tier-1)")
+    config.addinivalue_line(
         "markers", "elastic: elastic checkpoint plane — sharded "
         "snapshots with SHA-256 integrity, two-phase atomic commit "
         "(kill -9 at every boundary), N→M topology-elastic restore, "

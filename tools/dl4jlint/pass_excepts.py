@@ -78,10 +78,18 @@ OBS_PREFIX = "deeplearning4j_tpu/obs/"
 TRANSFER_ALLOWLIST: dict = {}
 TRANSFER_PREFIX = "deeplearning4j_tpu/serving/transfer.py"
 
+# The overload-survival policy plane (ISSUE-15) decides WHO gets the
+# KV pool under pressure: a swallowed error here silently starves or
+# wrongly preempts a priority class — no broad handlers at all,
+# pragma'd or not.  Same explicit-empty treatment as transfer.py.
+PRESSURE_ALLOWLIST: dict = {}
+PRESSURE_PREFIX = "deeplearning4j_tpu/serving/pressure.py"
+
 # prefix -> (allowlist, label) for the strict-mode passes (first match
 # wins, so file-level prefixes go before their parent directory)
 STRICT_PREFIXES = (
     (TRANSFER_PREFIX, TRANSFER_ALLOWLIST, "TRANSFER_ALLOWLIST"),
+    (PRESSURE_PREFIX, PRESSURE_ALLOWLIST, "PRESSURE_ALLOWLIST"),
     (SERVING_PREFIX, SERVING_ALLOWLIST, "SERVING_ALLOWLIST"),
     (OBS_PREFIX, OBS_ALLOWLIST, "OBS_ALLOWLIST"),
     (LAUNCHER_PREFIX, LAUNCHER_ALLOWLIST, "LAUNCHER_ALLOWLIST"),

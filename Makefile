@@ -6,7 +6,7 @@
 	bench-fleet bench-paged bench-procfleet test-obs bench-obs \
 	obs-smoke evidence lint test-lint test-elastic bench-elastic \
 	test-spec bench-spec test-disagg bench-disagg test-pressure \
-	bench-pressure
+	bench-pressure test-tenancy bench-tenants
 
 # lint first: the four-pass static sweep is ~1s and fails fast on a
 # race/host-sync/recompile-hazard/broad-except finding before the
@@ -87,6 +87,16 @@ bench-disagg:
 test-pressure:
 	python -m pytest tests/ -q -m pressure
 
+# Tenancy-plane tests only (registry/quotas/WFQ, per-tenant 429s,
+# burn-rate victim selection, fleet ledger reconciliation).
+test-tenancy:
+	python -m pytest tests/ -q -m tenancy
+
+# Multi-tenant isolation bench row: tenant-B best_effort flood at 5x
+# its token quota vs tenant-A's interactive wave on the same pool.
+bench-tenants:
+	BENCH_ONLY=tenants python bench.py
+
 # Overload-survival bench row: a mixed-priority storm sized to >2x the
 # paged pool's capacity, survival plane (priorities + preemption +
 # brownout) vs the all-FIFO baseline — gates zero failed interactive
@@ -147,7 +157,7 @@ smoke:
 # + the overload/admission-control row + the fleet mid-storm-kill row +
 # the paged-KV shared-prefix row).
 serving-smoke:
-	BENCH_ONLY=serving,servinglm,servingoverload,servingfleet,paged,speculative,disagg,pressure python bench.py
+	BENCH_ONLY=serving,servinglm,servingoverload,servingfleet,paged,speculative,disagg,pressure,tenants python bench.py
 
 # Precision-plane tests only (bf16-mixed parity/determinism, loss-scaler
 # overflow recovery, int8 serving agreement, dtype round-trips).

@@ -1888,6 +1888,201 @@ def bench_pressure() -> dict:
                     "the plane exists to protect"}
 
 
+def bench_tenants() -> dict:
+    """Multi-tenant isolation row (ISSUE-16 acceptance): tenant A
+    (interactive class, weight 4, generous quota, an SLO target) served
+    twice by identically-sized pools with the SAME tenant registry:
+
+    - baseline: A's request wave alone — its no-flood p99;
+    - flood: tenant B (best_effort class, small token quota) floods at
+      5x its quota via `chaos_tenant` while A runs the identical wave.
+
+    Gates: A's flood-leg p99 within 1.5x its no-flood baseline (WFQ +
+    quotas absorb the noisy neighbor), B actually throttled (429s
+    observed AND admitted tokens bounded by bucket refill + burst), A
+    never throttled, the per-tenant ledgers re-adding to the plane
+    totals with the page ledger balanced, and zero off-ladder compiles
+    — the flood must not push the pool onto new shapes."""
+    import dataclasses
+    import threading
+
+    import jax
+    import jax.monitoring
+
+    from deeplearning4j_tpu.parallel import transformer as tfm
+    from deeplearning4j_tpu.resilience.chaos import (
+        TenantChaosConfig,
+        chaos_tenant,
+    )
+    from deeplearning4j_tpu.serving import ContinuousLMServer
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = tfm.gpt2_small(max_len=256)
+        ps, pool_pages, slots = 16, 24, 8
+        a_threads, a_per_thread, plen, new = 4, 8, 8, 24
+        b_rate = 160.0
+    else:
+        cfg = dataclasses.replace(
+            tfm.gpt2_small(max_len=80), vocab_size=256, d_model=64,
+            n_heads=4, n_layers=2, d_ff=256, dtype="float32",
+            remat=False)
+        ps, pool_pages, slots = 16, 12, 4
+        a_threads, a_per_thread, plen, new = 3, 8, 8, 12
+        b_rate = 40.0
+    flood_cost = 8  # prompt 4 + max_new 4, the flood request's shape
+    # burst = ONE flood request: the bucket throttles from the second
+    # request on, so the 429 path fires even in a short smoke window
+    tenants = {"team-a": {"weight": 4.0, "rate": 1e5, "slo_ms": 500.0},
+               "team-b": {"weight": 1.0, "rate": b_rate,
+                          "burst": float(flood_cost)}}
+    rng = np.random.default_rng(0)
+    prompts = [[rng.integers(0, cfg.vocab_size, (plen,)).tolist()
+                for _ in range(a_per_thread)] for _ in range(a_threads)]
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+
+    def a_wave(srv):
+        """Tenant A's interactive wave: identical requests both legs,
+        closed-loop from a_threads clients.  Returns (latencies,
+        failed-count)."""
+        lats: list = []
+        failed = [0]
+        lock = threading.Lock()
+
+        def client(i):
+            for prompt in prompts[i]:
+                t0 = time.perf_counter()
+                try:
+                    srv.generate(list(prompt), new, timeout=600,
+                                 priority="interactive",
+                                 tenant="team-a")
+                    with lock:
+                        lats.append(time.perf_counter() - t0)
+                except Exception:  # noqa: BLE001 — tallied as failed
+                    with lock:
+                        failed[0] += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(a_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lats, failed[0]
+
+    def p99(xs):
+        if not xs:
+            return None
+        return round(float(np.percentile(xs, 99)) * 1e3, 1)
+
+    # Both legs run the wave ROUNDS times and keep each leg's best p99
+    # (the disagg row's discipline): on a single-core smoke host one
+    # scheduler hiccup lands a 24-sample p99 anywhere, and the gate is
+    # about what the WFQ/quota plane can hold, not OS noise.
+    rounds = 2
+
+    def make_server():
+        return ContinuousLMServer(cfg, params, slots=slots, kv="paged",
+                                  page_size=ps, pages=pool_pages,
+                                  prefill_chunk=4, tenants=tenants)
+
+    # ---- baseline leg: tenant A alone ------------------------------------
+    base = make_server()
+    try:
+        base.warmup()
+        base_legs = [a_wave(base) for _ in range(rounds)]
+        base_failed = sum(f for _, f in base_legs)
+        base_p99 = min(p99(ls) for ls, _ in base_legs)
+    finally:
+        base.stop()
+
+    # ---- flood leg: tenant B at 5x quota under tenant A's wave -----------
+    srv = make_server()
+    compiles: list = []
+
+    def listener(event, duration, **kw):
+        if event == "/jax/core/compile/backend_compile_duration":
+            compiles.append(event)
+
+    try:
+        srv.warmup()
+        jax.monitoring.register_event_duration_secs_listener(listener)
+        flood = chaos_tenant(srv, TenantChaosConfig(
+            tenant="team-b", rate_multiple=5.0, prompt_tokens=4,
+            max_new_tokens=4, priority="best_effort", threads=2,
+            timeout_s=2.0))
+        t_flood = time.perf_counter()
+        flood_thread = threading.Thread(target=flood.run, args=(600.0,),
+                                        daemon=True)
+        flood_thread.start()
+        try:
+            time.sleep(0.1)  # the neighbor is already noisy at t0
+            flood_legs = [a_wave(srv) for _ in range(rounds)]
+            failed = sum(f for _, f in flood_legs)
+            a_p99 = min(p99(ls) for ls, _ in flood_legs)
+            # hold the flood for a minimum window: A's wave can finish
+            # in well under a second on a small model, and the
+            # throttled-to-quota gate needs enough refill cycles for
+            # stable counts
+            while time.perf_counter() - t_flood < 1.0:
+                time.sleep(0.05)
+        finally:
+            flood.stop()
+            flood_thread.join(timeout=30)
+            flood_s = time.perf_counter() - t_flood
+            jax.monitoring.clear_event_listeners()
+        stats = srv.stats()
+        with srv._cond:
+            page_ledger = srv._pool.check_ledger()
+    finally:
+        srv.stop()
+
+    fstats = flood.stats()
+    tenancy = stats.get("tenancy", {})
+    # per-tenant ledgers must re-add to the plane totals (the same
+    # invariant check_fleet_ledger enforces fleet-wide)
+    cells = stats.get("tenants", {})
+    reconciled = all(
+        sum(int(c.get(e) or 0) for c in cells.values())
+        == int(stats.get(e) or 0)
+        for e in ("requests", "rejected", "shed", "deadline_missed"))
+    b_tokens_in = int(tenancy.get("team-b", {}).get("tokens_in") or 0)
+    # admitted tokens bounded by what the bucket could have refilled:
+    # burst + rate x window, with 1.5x slack + one request of slop
+    b_quota_cap = 1.5 * (b_rate + b_rate * flood_s) + flood_cost
+    a_throttled = int(tenancy.get("team-a", {}).get("throttled") or 0)
+    meets = bool(
+        failed == 0 and base_failed == 0
+        and a_p99 is not None and base_p99 is not None
+        and a_p99 <= 1.5 * base_p99
+        and fstats["throttled"] > 0
+        and b_tokens_in <= b_quota_cap
+        and a_throttled == 0
+        and reconciled and page_ledger["balanced"]
+        and not compiles)
+    return {"metric": "TransformerLM multi-tenant interactive p99 "
+                      "(tenant-B best_effort flood at 5x quota)",
+            "unit": "ms", "value": a_p99,
+            "requests": a_threads * a_per_thread * rounds,
+            "rounds": rounds,
+            **_mem_fields(params=params),
+            "no_flood_p99_ms": base_p99,
+            "p99_vs_no_flood": (round(a_p99 / base_p99, 2)
+                                if a_p99 and base_p99 else None),
+            "a_failed": failed, "a_throttled": a_throttled,
+            "flood": fstats, "flood_window_s": round(flood_s, 2),
+            "flood_tokens_admitted": b_tokens_in,
+            "flood_quota_cap_tokens": round(b_quota_cap, 1),
+            "tenant_ledgers_reconciled": reconciled,
+            "page_ledger_balanced": page_ledger["balanced"],
+            "off_ladder_compiles": len(compiles),
+            "meets_acceptance": meets,
+            "note": "same pool sizing and registry both legs; the "
+                    "flood leg adds only the noisy neighbor — WFQ "
+                    "weights plus the token bucket are what keep "
+                    "tenant A's p99 inside 1.5x of its quiet baseline"}
+
+
 def bench_speculative() -> dict:
     """Speculative-decode row (ISSUE-13 acceptance): the bench_paged_kv
     shared-prefix greedy storm served by the PR-7 paged pool
@@ -2480,6 +2675,7 @@ BENCHES = {
     "paged": bench_paged_kv,
     "speculative": bench_speculative,
     "pressure": bench_pressure,
+    "tenants": bench_tenants,
     "precision": bench_precision,
     "flashab": bench_flash_ab,
     "longctx": bench_longctx,

@@ -459,6 +459,14 @@ def cmd_serve(args) -> int:
     deadline_s = args.deadline_ms / 1e3 if args.deadline_ms > 0 else None
     breaker_n = (args.breaker_threshold if args.breaker_threshold > 0
                  else None)
+    # ONE registry shared by both planes (ISSUE-16): a tenant's token
+    # bucket and burn rate span /model/predict and /lm/generate — two
+    # per-plane registries would hand every tenant double its quota
+    tenants = None
+    if args.tenants:
+        from deeplearning4j_tpu.serving.tenancy import TenantRegistry
+
+        tenants = TenantRegistry.from_json(args.tenants)
     srv = UiServer(host=args.host, port=args.port)
     if args.model:
         net = _build_net(args.model)
@@ -471,7 +479,7 @@ def cmd_serve(args) -> int:
                         max_queue_depth=max_queue,
                         default_deadline_s=deadline_s,
                         breaker_threshold=breaker_n,
-                        quantize=quantize)
+                        quantize=quantize, tenants=tenants)
         if quantize:
             rep = srv.state.engine._model().quantization_report()
             ratio = rep["float_param_bytes"] / max(rep["param_bytes"], 1)
@@ -520,7 +528,7 @@ def cmd_serve(args) -> int:
                      ship=args.lm_ship,
                      preempt=args.lm_preempt,
                      swap_bytes=int(args.lm_swap_mb * (1 << 20)),
-                     brownout=args.lm_brownout)
+                     brownout=args.lm_brownout, tenants=tenants)
         lm_srv = srv.state.lm_server
         # -warmup opts the LM pool into pre-traffic compiles too, same
         # contract as the classifier path: without it each program
@@ -553,6 +561,11 @@ def cmd_serve(args) -> int:
           f"deadline_ms={args.deadline_ms or 'none'} "
           f"breaker_threshold={breaker_n or 'off'} "
           f"drain_grace_s={args.drain_grace_s}")
+    if tenants is not None:
+        names = ", ".join(tenants.names())
+        print(f"serve: tenancy on — WFQ + token quotas for [{names}] "
+              f"(X-Tenant header or 'tenant' field; unknown tenants "
+              f"get 400, over-quota gets 429 + Retry-After)")
     print(f"Serving on {srv.url} — POST /model/predict, /lm/generate; "
           f"GET /serving/stats, /metrics, /trace/recent, /healthz, "
           f"/readyz")
@@ -1417,6 +1430,17 @@ def build_parser() -> argparse.ArgumentParser:
                               "pressure degrade speculation, prefill "
                               "width, then best_effort lanes before "
                               "shedding anything (paged KV only)")
+    p_serve.add_argument("-tenants", "--tenants", default=None,
+                         help="multi-tenant traffic shaping (JSON): an "
+                              "object mapping tenant name -> spec, e.g. "
+                              '\'{"interactive": {"weight": 4, '
+                              '"rate": 2000, "slo_ms": 250}}\' — each '
+                              "spec takes weight (WFQ share), "
+                              "rate (tokens/s quota; 0 = unmetered), "
+                              "burst, slo_ms and slo_budget; a "
+                              "'default' tenant always exists, so "
+                              "clients that never send a tenant keep "
+                              "the exact single-tenant behavior")
     p_serve.add_argument("-serve-seconds", "--serve-seconds",
                          dest="serve_seconds", type=float, default=0,
                          help="stop after this many seconds (0 = run "

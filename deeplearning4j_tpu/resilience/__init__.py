@@ -32,11 +32,13 @@ from deeplearning4j_tpu.resilience.chaos import (
     InjectedDispatchFault,
     ProcessChaosConfig,
     ServingChaosConfig,
+    TenantChaosConfig,
     chaos_checkpoint,
     chaos_dispatch,
     chaos_fleet,
     chaos_procfleet,
     chaos_runner,
+    chaos_tenant,
     corrupt_checkpoint,
     flip_byte,
     truncate_file,
@@ -70,11 +72,13 @@ __all__ = [
     "InjectedDispatchFault",
     "ProcessChaosConfig",
     "ServingChaosConfig",
+    "TenantChaosConfig",
     "chaos_checkpoint",
     "chaos_dispatch",
     "chaos_fleet",
     "chaos_procfleet",
     "chaos_runner",
+    "chaos_tenant",
     "corrupt_checkpoint",
     "flip_byte",
     "truncate_file",

@@ -45,6 +45,13 @@ the refcount ledger), and `chaos_swap` corrupts or drops configured
 `SwapStore.put`s so the restore path's SHA-256 detection and the
 recompute-from-prompt fallback both run in tier-1.
 
+The TENANCY plane (ISSUE-16) gets its adversary: `chaos_tenant` runs
+closed-loop flood threads submitting as ONE tenant at a multiple of its
+token quota — the deterministic noisy neighbor that drives the 429
+path, WFQ isolation under contention, and burn-rate-driven brownout
+victim selection, with a submitted/throttled/completed ledger the bench
+gates on.
+
 Process SUPERVISION (ISSUE-10) gets real-process faults: `chaos_procfleet`
 SIGKILLs / SIGSTOPs actual worker processes at configured dispatch
 attempts and boot-flakes configured spawns (exit-code-N commands), so
@@ -550,6 +557,149 @@ def chaos_pool(pool, config: PoolChaosConfig) -> _PoolChaos:
     `serving.paged.PagePool` (see `PoolChaosConfig`); returns the
     wrapper — ``.uninstall()`` restores the real `alloc`."""
     return _PoolChaos(pool, config)
+
+
+# ---------------------------------------------------------------------------
+# Tenancy fault injection (ISSUE-16: the noisy-neighbor flood)
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantChaosConfig:
+    """A deterministic noisy neighbor for the tenancy plane: closed-loop
+    flood threads submitting as one tenant at a multiple of its token
+    quota, so WFQ isolation, the 429 path, and burn-rate-driven
+    brownout victim selection are all drivable in tier-1 without a real
+    abusive client.
+
+    - ``tenant``: the flooding identity (must exist in the server's
+      registry — the flood exercises enforcement, not the unknown-
+      tenant 400);
+    - ``rate_multiple``: offered token rate as a multiple of the
+      tenant's quota (5.0 = five times what the bucket refills);
+      for an unmetered tenant the flood just runs flat out;
+    - ``prompt_tokens`` / ``max_new_tokens``: per-request shape (their
+      sum is the per-request quota cost);
+    - ``priority``: admission class the flood claims (default
+      ``best_effort`` — the class the brownout ladder sheds first);
+    - ``threads``: concurrent closed-loop submitters;
+    - ``timeout_s``: per-request client wait bound.
+    """
+
+    tenant: str = "flood"
+    rate_multiple: float = 5.0
+    prompt_tokens: int = 4
+    max_new_tokens: int = 4
+    priority: str = "best_effort"
+    threads: int = 2
+    timeout_s: float = 5.0
+
+
+class _TenantFlood:
+    """Closed-loop flood threads against a `ContinuousLMServer` with a
+    tenant registry installed.  Counters (the test/bench observables):
+    ``submitted``, ``completed``, ``throttled`` (quota 429s),
+    ``rejected`` (any other typed refusal — overload shed, deadline),
+    ``tokens_out`` (generated tokens actually served to the flood)."""
+
+    def __init__(self, server, config: TenantChaosConfig):
+        import threading
+
+        # typed errors imported lazily: resilience/chaos.py must stay
+        # importable without the serving plane
+        from deeplearning4j_tpu.serving.resilience import (
+            ServingError, TenantQuotaError)
+
+        if getattr(server, "tenants", None) is None:
+            raise ValueError(
+                "chaos_tenant needs a server with a tenant registry")
+        self.server = server
+        self.config = config
+        self._quota_error = TenantQuotaError
+        self._typed_error = ServingError
+        spec = server.tenants.spec(config.tenant)
+        cost = max(1, int(config.prompt_tokens)
+                   + int(config.max_new_tokens))
+        # closed-loop pacing: each thread sleeps so the flood's OFFERED
+        # token rate is rate_multiple × quota — fast enough to always
+        # be over budget, slow enough that the observables (throttled
+        # vs completed counts) are stable across machines
+        if spec.rate > 0:
+            per_s = spec.rate * max(1.0, float(config.rate_multiple))
+            self.interval_s = cost * max(1, config.threads) / per_s
+        else:
+            self.interval_s = 0.0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list = []
+        self.submitted = 0
+        self.completed = 0
+        self.throttled = 0
+        self.rejected = 0
+        self.tokens_out = 0
+
+    def _loop(self, deadline: float, seed: int) -> None:
+        cfg = self.config
+        prompt = [1 + (seed % 7)] * max(1, int(cfg.prompt_tokens))
+        while (not self._stop.is_set()
+               and time.perf_counter() < deadline):
+            with self._lock:
+                self.submitted += 1
+            try:
+                out = self.server.generate(
+                    prompt, int(cfg.max_new_tokens),
+                    timeout=cfg.timeout_s, priority=cfg.priority,
+                    tenant=cfg.tenant)
+                with self._lock:
+                    self.completed += 1
+                    self.tokens_out += max(0, len(out) - len(prompt))
+            except self._quota_error:
+                with self._lock:
+                    self.throttled += 1
+            except self._typed_error:
+                with self._lock:
+                    self.rejected += 1
+            if self.interval_s:
+                time.sleep(self.interval_s)
+
+    def run(self, duration_s: float) -> "_TenantFlood":
+        """Flood for ``duration_s`` wall seconds (blocking), then join
+        every thread; returns self so counters chain."""
+        import threading
+
+        deadline = time.perf_counter() + max(0.0, float(duration_s))
+        self._threads = [
+            threading.Thread(target=self._loop, args=(deadline, i),
+                             daemon=True,
+                             name=f"tenant-flood-{self.config.tenant}-{i}")
+            for i in range(max(1, int(self.config.threads)))]
+        for t in self._threads:
+            t.start()
+        for t in self._threads:
+            t.join()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self.config.timeout_s)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"tenant": self.config.tenant,
+                    "submitted": self.submitted,
+                    "completed": self.completed,
+                    "throttled": self.throttled,
+                    "rejected": self.rejected,
+                    "tokens_out": self.tokens_out}
+
+
+def chaos_tenant(server, config: TenantChaosConfig) -> _TenantFlood:
+    """Build a deterministic noisy-neighbor flood against a
+    `serving.lm.ContinuousLMServer` with a tenant registry (see
+    `TenantChaosConfig`).  ``chaos_tenant(server, cfg).run(1.0)``
+    floods for a second and returns the wrapper; ``.stats()`` has the
+    submitted/throttled/completed ledger the bench gates on."""
+    return _TenantFlood(server, config)
 
 
 # ---------------------------------------------------------------------------

@@ -79,6 +79,21 @@ coalescing K concurrent *requests* per device dispatch.
   prefill width, then best_effort lanes before shedding anything,
   hysteresis both directions, every transition counted
   (docs/robustness.md "The degradation ladder");
+- multi-tenant traffic shaping (`tenancy.py`, ISSUE-16): a
+  `TenantRegistry` of named `TenantSpec`s (WFQ weight, token-rate
+  quota + burst, SLO target), accepted on every front via the
+  `tenant` field or `X-Tenant` header (the built-in `default` tenant
+  keeps pre-tenancy behavior byte-for-byte); a `TokenBucketMeter`
+  whose 429s carry a Retry-After derived from the bucket's own refill
+  (floored at the brownout ladder's exit timescale while it is up); a
+  `FairQueueClock` stamping virtual finish times so the admission
+  queue orders by (priority rank, vft, arrival) — weighted fair
+  sharing WITHIN a class, classes still dominate, one tenant == the
+  historic FIFO; an `SLOTracker` whose burn rate picks brownout
+  victims (a compliant tenant's best_effort admits through L4 while
+  an offender exists); per-tenant ledgers that must re-add to the
+  plane totals (`check_fleet_ledger` reports drift as a typed
+  failure) — docs/robustness.md "Tenancy & SLOs";
 - process supervision (`procfleet.py`, ISSUE-10): `FleetSupervisor`
   owns spawned worker processes end-to-end — exit-status + `/readyz`
   crash detection with clean/crash/wedged classification, exponential
@@ -144,7 +159,16 @@ from deeplearning4j_tpu.serving.resilience import (
     ServingError,
     ServingOverloadError,
     ServingUnavailableError,
+    TenantQuotaError,
     UnservableShapeError,
+)
+from deeplearning4j_tpu.serving.tenancy import (
+    DEFAULT_TENANT,
+    FairQueueClock,
+    SLOTracker,
+    TenantRegistry,
+    TenantSpec,
+    TokenBucketMeter,
 )
 from deeplearning4j_tpu.serving.transfer import (
     PageExport,
@@ -162,8 +186,10 @@ __all__ = [
     "ContinuousLMServer",
     "CrashLoopError",
     "DEFAULT_BATCH_BUCKETS",
+    "DEFAULT_TENANT",
     "DeadlineExceededError",
     "Drafter",
+    "FairQueueClock",
     "FleetClientError",
     "FleetRouter",
     "FleetServer",
@@ -186,10 +212,15 @@ __all__ = [
     "ServingEngine",
     "ServingError",
     "ServingMetrics",
+    "SLOTracker",
     "ServingOverloadError",
     "ServingUnavailableError",
     "SwapEvictedError",
     "SwapStore",
+    "TenantQuotaError",
+    "TenantRegistry",
+    "TenantSpec",
+    "TokenBucketMeter",
     "UnservableShapeError",
     "WorkerSpec",
     "check_compatible",

@@ -56,8 +56,14 @@ from deeplearning4j_tpu.serving.resilience import (
     CircuitBreaker,
     CircuitOpenError,
     DeadlineExceededError,
+    ServingError,
     ServingUnavailableError,
     check_admission,
+)
+from deeplearning4j_tpu.serving.tenancy import (
+    DEFAULT_TENANT,
+    TenantQuotaError,
+    TenantRegistry,
 )
 
 # Backoff between bisection sub-dispatches: short — the worker thread is
@@ -91,7 +97,8 @@ def _build_serving_trace(raw):
 
 class _Pending:
     __slots__ = ("x", "mask", "event", "result", "error", "enqueued",
-                 "deadline", "abandoned", "request_id", "t_start", "t_end")
+                 "deadline", "abandoned", "request_id", "t_start", "t_end",
+                 "tenant")
 
     def __init__(self, x: np.ndarray, mask: Optional[np.ndarray],
                  deadline: Optional[float] = None,
@@ -107,6 +114,7 @@ class _Pending:
         self.request_id = request_id   # X-Request-Id (tracing, ISSUE-8)
         self.t_start: Optional[float] = None  # dispatch start (worker)
         self.t_end: Optional[float] = None    # dispatch end (worker)
+        self.tenant = DEFAULT_TENANT   # billing identity (ISSUE-16)
 
     @property
     def key(self):
@@ -139,7 +147,8 @@ class MicroBatcher:
                  breaker: Optional[CircuitBreaker] = None,
                  max_bisect_depth: int = 6,
                  bisect_policy: RetryPolicy = _BISECT_POLICY,
-                 tracer: Optional[TraceRecorder] = None):
+                 tracer: Optional[TraceRecorder] = None,
+                 tenants=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -163,6 +172,9 @@ class MicroBatcher:
         self.tracer = tracer
         self._compile_watch = compile_watcher() if tracer is not None \
             else None
+        # multi-tenant admission gate (ISSUE-16): None = unmetered (the
+        # historic single-tenant behavior, bit for bit)
+        self.tenants = TenantRegistry.coerce(tenants)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         if breaker is not None:
             breaker.add_listener(self.metrics.set_breaker_state)
@@ -187,7 +199,8 @@ class MicroBatcher:
     def submit(self, x: np.ndarray, mask: Optional[np.ndarray] = None,
                timeout: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> np.ndarray:
+               request_id: Optional[str] = None,
+               tenant: Optional[str] = None) -> np.ndarray:
         """Enqueue a [n, ...] request and block for its [n, ...] outputs.
 
         `timeout` bounds the *client's* wait; `deadline_s` (default
@@ -195,7 +208,11 @@ class MicroBatcher:
         sheds the request before dispatch once it expires — a client that
         has already given up must not cost device time.  `request_id`
         names the request's trace when a tracer is attached (one is
-        minted otherwise)."""
+        minted otherwise).  `tenant` (default "default") is the billing
+        identity: with a `TenantRegistry` installed the request is
+        charged one quota token per row BEFORE the shared admission
+        gate, so an over-quota tenant's refusal (`TenantQuotaError`,
+        HTTP 429) never consumes the queue bound (ISSUE-16)."""
         x = np.asarray(x)
         if x.ndim < 2 or x.shape[0] < 1:
             raise ValueError(f"request must be [n, ...] with n >= 1, got "
@@ -209,15 +226,42 @@ class MicroBatcher:
             request_id = new_request_id()
         item = _Pending(x, None if mask is None else np.asarray(mask),
                         request_id=request_id)
+        if self.tenants is not None:
+            item.tenant = self.tenants.normalize(tenant)
+        elif tenant is not None and str(tenant) != DEFAULT_TENANT:
+            raise ValueError(
+                f"unknown tenant {str(tenant)!r}: no tenant registry "
+                f"is installed (serve -tenants, or "
+                f"MicroBatcher(tenants=...))")
         if deadline_s is not None:
             item.deadline = item.enqueued + float(deadline_s)
         with self._cond:
-            check_admission(
-                accepting=self._accepting, breaker=self.breaker,
-                queue_depth=len(self._queue),
-                max_queue_depth=self.max_queue_depth,
-                metrics=self.metrics,
-                retry_after_s=self._retry_after_locked, what="serving")
+            if self._accepting and self.tenants is not None:
+                try:
+                    # one quota token per example row, charged before
+                    # the shared gate (the 429 is the CLIENT's budget,
+                    # not the server's capacity)
+                    self.tenants.meter.charge(item.tenant,
+                                              int(x.shape[0]))
+                except TenantQuotaError:
+                    self.metrics.record_rejected()
+                    self.metrics.record_tenant("rejected", item.tenant)
+                    self.metrics.record_tenant("throttled", item.tenant)
+                    raise
+            try:
+                check_admission(
+                    accepting=self._accepting, breaker=self.breaker,
+                    queue_depth=len(self._queue),
+                    max_queue_depth=self.max_queue_depth,
+                    metrics=self.metrics,
+                    retry_after_s=self._retry_after_locked, what="serving")
+            except ServingError:
+                # the shared gate counted the rejection; the per-tenant
+                # ledger rides along so the fleet reconciliation
+                # (submitted == Σ tenants) keeps holding (ISSUE-16)
+                if self.tenants is not None:
+                    self.metrics.record_tenant("rejected", item.tenant)
+                raise
             if not self._running:
                 self._start_locked()
             self._queue.append(item)
@@ -237,6 +281,8 @@ class MicroBatcher:
                     self._queue.remove(item)
                     self.metrics.set_queue_depth(len(self._queue))
                     self.metrics.record_shed()
+                    if self.tenants is not None:
+                        self.metrics.record_tenant("shed", item.tenant)
                 except ValueError:
                     item.abandoned = True  # worker holds it: discard rows
                     # exactly-once shed accounting for the race: a result
@@ -246,6 +292,9 @@ class MicroBatcher:
                     # an unset event means the worker's finally counts it
                     if item.event.is_set() and item.error is None:
                         self.metrics.record_shed()
+                        if self.tenants is not None:
+                            self.metrics.record_tenant("shed",
+                                                       item.tenant)
                 resolved_with_error = (item.event.is_set()
                                        and item.error is not None)
             if (item.deadline is not None and now >= item.deadline
@@ -255,6 +304,9 @@ class MicroBatcher:
                 # already resolve (and account) the item — a bare
                 # client-wait timeout is client impatience, not shedding
                 self.metrics.record_deadline_missed()
+                if self.tenants is not None:
+                    self.metrics.record_tenant("deadline_missed",
+                                               item.tenant)
             self._trace_item(item, time.perf_counter(), "timeout")
             raise DeadlineExceededError(
                 f"serving request timed out after {timeout}s")
@@ -271,6 +323,14 @@ class MicroBatcher:
                     else done) - item.t_start
         self.metrics.record_request(done - item.enqueued,
                                     queue_wait_s=qw, compute_s=comp)
+        if self.tenants is not None:
+            # tenant completion ledger (ISSUE-16): served count, rows
+            # out, and the SLO window sample behind the burn gauge
+            self.metrics.record_tenant("requests", item.tenant)
+            self.tenants.meter.record_out(item.tenant, int(x.shape[0]))
+            self.tenants.slo.record(item.tenant, done - item.enqueued)
+            self.metrics.set_tenant_burn(
+                item.tenant, self.tenants.slo.burn_rate(item.tenant))
         self._trace_item(item, done, "ok")
         return item.result
 
@@ -310,6 +370,8 @@ class MicroBatcher:
             self.metrics.set_queue_depth(0)
         for item in leftovers:
             self.metrics.record_shed()
+            if self.tenants is not None:
+                self.metrics.record_tenant("shed", item.tenant)
             item.error = ServingUnavailableError("batcher stopped")
             item.event.set()
 
@@ -361,10 +423,16 @@ class MicroBatcher:
         for item in self._queue:
             if item.abandoned:
                 shed += 1
+                if self.tenants is not None:
+                    self.metrics.record_tenant("shed", item.tenant)
                 item.event.set()
             elif item.deadline is not None and now >= item.deadline:
                 shed += 1
                 self.metrics.record_deadline_missed()
+                if self.tenants is not None:
+                    self.metrics.record_tenant("shed", item.tenant)
+                    self.metrics.record_tenant("deadline_missed",
+                                               item.tenant)
                 item.error = DeadlineExceededError(
                     f"deadline exceeded after "
                     f"{now - item.enqueued:.3f}s in queue; shed before "
@@ -495,6 +563,9 @@ class MicroBatcher:
                     for g in group:
                         if g.abandoned:
                             self.metrics.record_shed()
+                            if self.tenants is not None:
+                                self.metrics.record_tenant("shed",
+                                                           g.tenant)
                             g.event.set()
                         else:
                             live.append(g)
@@ -509,6 +580,8 @@ class MicroBatcher:
                         retry_after_s=self.breaker.retry_after_s())
                     for g in group:
                         self.metrics.record_shed()
+                        if self.tenants is not None:
+                            self.metrics.record_tenant("shed", g.tenant)
                         g.error = err
                     continue
                 try:
@@ -549,6 +622,9 @@ class MicroBatcher:
                         # second (see submit's timeout path)
                         if g.abandoned and not g.event.is_set():
                             self.metrics.record_shed()
+                            if self.tenants is not None:
+                                self.metrics.record_tenant("shed",
+                                                           g.tenant)
                         # never resolve a client with silent None: if
                         # neither result nor error was assigned, the
                         # cycle aborted — fail typed

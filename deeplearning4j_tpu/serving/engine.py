@@ -57,7 +57,8 @@ class ServingEngine:
                  breaker_cooldown_s: float = 1.0,
                  quantize: Optional[str] = None,
                  tracer: Optional[TraceRecorder] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 tenants=None):
         self.net = net
         self.ladder = ladder if ladder is not None else BucketLadder()
         # Precision plane (ISSUE-5): `quantize="int8"` serves per-channel
@@ -107,7 +108,7 @@ class ServingEngine:
             max_wait_ms=max_wait_ms, metrics=self.metrics,
             max_queue_depth=max_queue_depth,
             default_deadline_s=default_deadline_s,
-            breaker=self.breaker, tracer=tracer)
+            breaker=self.breaker, tracer=tracer, tenants=tenants)
         if self.batcher.max_batch > self.ladder.max_batch:
             raise ValueError(
                 f"max_batch ({self.batcher.max_batch}) exceeds the "
@@ -186,27 +187,31 @@ class ServingEngine:
 
     def predict_proba(self, x, timeout: Optional[float] = None,
                       deadline_s: Optional[float] = None,
-                      request_id: Optional[str] = None) -> np.ndarray:
+                      request_id: Optional[str] = None,
+                      tenant: Optional[str] = None) -> np.ndarray:
         """[n, ...] features -> [n, classes] output activations (or
         [n, T, classes] for sequence-tagging outputs, sliced back to the
         request's own T).  `deadline_s` rides the queue item so expired
         work is shed before dispatch (docs/robustness.md); `request_id`
-        names the request's trace (``X-Request-Id``)."""
+        names the request's trace (``X-Request-Id``); `tenant` is the
+        billing identity the batcher's quota gate charges (ISSUE-16)."""
         x, mask, t = self._prepare(x)
         out = self.batcher.submit(x, mask, timeout=timeout,
                                   deadline_s=deadline_s,
-                                  request_id=request_id)
+                                  request_id=request_id, tenant=tenant)
         if t is not None and out.ndim == 3 and out.shape[1] != t:
             out = out[:, :t]       # drop the length-bucket padding steps
         return out
 
     def predict(self, x, timeout: Optional[float] = None,
                 deadline_s: Optional[float] = None,
-                request_id: Optional[str] = None) -> np.ndarray:
+                request_id: Optional[str] = None,
+                tenant: Optional[str] = None) -> np.ndarray:
         """[n, ...] features -> [n] argmax class indices."""
         return np.argmax(self.predict_proba(x, timeout=timeout,
                                             deadline_s=deadline_s,
-                                            request_id=request_id),
+                                            request_id=request_id,
+                                            tenant=tenant),
                          axis=-1)
 
     # ---- lifecycle --------------------------------------------------------

@@ -78,6 +78,7 @@ import hashlib
 import http.client
 import inspect
 import json
+import math
 import subprocess
 import threading
 import time
@@ -118,11 +119,17 @@ class FleetClientError(ValueError):
     """A replica answered 4xx: the request payload itself is wrong, so
     retrying it on a different replica would just fail again — the
     router propagates it instead of failing over.  Maps back to the
-    replica's status code at the fleet front."""
+    replica's status code at the fleet front.  A quota 429 (ISSUE-16)
+    is exactly this shape — every replica sharing the tenant registry
+    would refuse identically — and carries the replica's own
+    ``retry_after_s`` so the front can relay the Retry-After header."""
 
-    def __init__(self, msg: str, status: int = 400):
+    def __init__(self, msg: str, status: int = 400,
+                 retry_after_s: Optional[float] = None):
         super().__init__(msg)
         self.status = int(status)
+        self.retry_after_s = (None if retry_after_s is None
+                              else float(retry_after_s))
 
 
 class _ReplicaDispatchError(RuntimeError):
@@ -281,6 +288,7 @@ def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
                         lm_preempt: bool = False,
                         lm_swap_bytes: int = 64 << 20,
                         lm_brownout=None,
+                        lm_tenants=None,
                         role: str = ROLE_BOTH,
                         version: int = 0) -> Replica:
     """Thread-hosted replica: an in-process `UiServer` on a free port
@@ -320,7 +328,8 @@ def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
                      prefill_chunk=lm_prefill_chunk,
                      speculate=lm_speculate, draft_len=lm_draft_len,
                      ship=ship, preempt=lm_preempt,
-                     swap_bytes=lm_swap_bytes, brownout=lm_brownout)
+                     swap_bytes=lm_swap_bytes, brownout=lm_brownout,
+                     tenants=lm_tenants)
         # warm the paged programs BEFORE the replica enters rotation —
         # same zero-compile-on-the-request-path rule as warmup_example
         if srv.state.lm_server is not None:
@@ -614,13 +623,16 @@ class FleetRouter:
             except urllib.error.HTTPError as e:
                 status = e.code
                 try:
-                    detail = json.loads(e.read() or b"{}").get("error", "")
+                    err_payload = json.loads(e.read() or b"{}")
                 except ValueError:
-                    detail = ""
+                    err_payload = {}
+                detail = err_payload.get("error", "")
                 if 400 <= status < 500:
                     raise FleetClientError(
                         detail or f"replica {replica.name} answered "
-                                  f"{status}", status=status) from e
+                                  f"{status}", status=status,
+                        retry_after_s=err_payload.get(
+                            "retry_after_s")) from e
                 # 503/504: alive but unavailable (overload / draining /
                 # deadline) — fail over penalty-free.  Any other 5xx is
                 # a replica fault and counts toward ejection.
@@ -758,24 +770,31 @@ class FleetRouter:
 
     def predict_proba(self, x, deadline_s: Optional[float] = None,
                       timeout: Optional[float] = None,
-                      request_id: Optional[str] = None) -> np.ndarray:
+                      request_id: Optional[str] = None,
+                      tenant: Optional[str] = None) -> np.ndarray:
         """[n, ...] features -> [n, classes] activations, served by
         whichever healthy replica the router picks (float32 survives the
         JSON hop bit-exactly: float32 -> float64 -> shortest-repr
-        round-trip -> float32 is the identity)."""
+        round-trip -> float32 is the identity).  `tenant` forwards
+        verbatim (ISSUE-16): the replica's registry owns the vocabulary
+        — unknown 400s there, over-quota 429s there, both typed."""
         body: Dict = {"features": np.asarray(x, np.float32).tolist()}
         if deadline_s is not None:
             body["deadline_ms"] = float(deadline_s) * 1e3
+        if tenant is not None:
+            body["tenant"] = str(tenant)
         payload = self._submit("/model/predict", body, timeout=timeout,
                                request_id=request_id)
         return np.asarray(payload["outputs"], np.float32)
 
     def predict(self, x, deadline_s: Optional[float] = None,
                 timeout: Optional[float] = None,
-                request_id: Optional[str] = None) -> np.ndarray:
+                request_id: Optional[str] = None,
+                tenant: Optional[str] = None) -> np.ndarray:
         return np.argmax(self.predict_proba(x, deadline_s=deadline_s,
                                             timeout=timeout,
-                                            request_id=request_id),
+                                            request_id=request_id,
+                                            tenant=tenant),
                          axis=-1)
 
     def _lm_affinity_key(self, ids: Sequence[int],
@@ -818,7 +837,8 @@ class FleetRouter:
                          timeout: Optional[float] = None,
                          request_id: Optional[str] = None,
                          session_id: Optional[str] = None,
-                         priority: Optional[str] = None) -> Dict:
+                         priority: Optional[str] = None,
+                         tenant: Optional[str] = None) -> Dict:
         """LM generation with affinity routing and role scheduling.
 
         Affinity: a sticky `session_id` (when sent) or the first
@@ -851,6 +871,11 @@ class FleetRouter:
             # forwarded verbatim: the replica's admission gate owns the
             # vocabulary, so an unknown class 400s there and propagates
             body["priority"] = str(priority)
+        if tenant is not None:
+            # same verbatim-forward contract (ISSUE-16): the replica's
+            # tenant registry owns the vocabulary — unknown 400s there,
+            # over-quota 429s there, and both propagate typed
+            body["tenant"] = str(tenant)
         if int(top_k):
             body["top_k"] = int(top_k)
         if float(top_p) < 1.0:
@@ -1073,7 +1098,8 @@ class FleetRouter:
                        timeout: Optional[float] = None,
                        request_id: Optional[str] = None,
                        session_id: Optional[str] = None,
-                       priority: Optional[str] = None):
+                       priority: Optional[str] = None,
+                       tenant: Optional[str] = None):
         """Open one SSE token stream against a decode-capable replica
         (affinity-routed like `generate_payload`); returns the raw
         `http.client`-style response object — the caller relays/parses
@@ -1102,6 +1128,8 @@ class FleetRouter:
             body["session_id"] = str(session_id)
         if priority is not None:
             body["priority"] = str(priority)
+        if tenant is not None:
+            body["tenant"] = str(tenant)
         if deadline_s is not None:
             body["deadline_ms"] = float(deadline_s) * 1e3
         rid = request_id or new_request_id()
@@ -1544,6 +1572,27 @@ class FleetRouter:
                 saw_pressure = True
         if saw_pressure:
             fleet["lm_pressure"] = pressure
+        # fleet-level tenancy view (ISSUE-16): per-tenant event totals
+        # summed across both planes of every replica, burn rate folded
+        # as the MAX across replicas — a tenant is as unhealthy as its
+        # worst pool's view of it, and averaging would let one melting
+        # replica hide behind nine idle ones
+        tenant_agg: Dict[str, Dict] = {}
+        for payload in stats_by_name.values():
+            for plane in ("classifier", "lm"):
+                section = (payload or {}).get(plane) or {}
+                for tn, cell in (section.get("tenants") or {}).items():
+                    slot = tenant_agg.setdefault(tn, {})
+                    for event, v in cell.items():
+                        if event == "burn_rate":
+                            slot["burn_rate"] = max(
+                                float(slot.get("burn_rate") or 0.0),
+                                float(v))
+                        else:
+                            slot[event] = (int(slot.get(event) or 0)
+                                           + int(v))
+        if tenant_agg:
+            fleet["tenants"] = tenant_agg
         out = {"fleet": fleet, "replicas": entries, "retired": retired}
         supervisor = self.supervisor
         if supervisor is not None:
@@ -1587,6 +1636,41 @@ def _fold_plane_counts(agg: Dict, payload: Dict) -> None:
             agg[k] += int(section.get(k) or 0)
 
 
+_RECONCILE_EVENTS = ("requests", "rejected", "shed", "deadline_missed")
+
+
+def _reconcile_breakdowns(name: str, payload: Dict,
+                          failures: List[str]) -> None:
+    """Per-replica, per-plane breakdown reconciliation (ISSUE-16
+    satellite): every accounting site carries its priority-class and
+    tenant labels along with the plane total, so within one plane the
+    per-class and per-tenant ledgers must each re-add to that plane's
+    own counters.  A breakdown that drifts from its total means some
+    site bumped a counter without its ride-along (or vice versa) —
+    exactly the bug class this check exists to catch.  The breakdown
+    sections are fire-once (absent until the plane records a classed /
+    tenanted event), so an absent section is vacuously balanced; a
+    PRESENT section must account for everything, which is why the
+    default priority class and the `default` tenant are real labels
+    rather than an untracked remainder."""
+    for plane in ("classifier", "lm"):
+        section = payload.get(plane)
+        if not section:
+            continue
+        for breakdown in ("priority", "tenants"):
+            cells = section.get(breakdown)
+            if not cells:
+                continue
+            for event in _RECONCILE_EVENTS:
+                total = int(section.get(event) or 0)
+                part = sum(int(c.get(event) or 0)
+                           for c in cells.values())
+                if part != total:
+                    failures.append(
+                        f"{name}/{plane}: sum({breakdown}.{event})="
+                        f"{part} != {event}={total}")
+
+
 def check_fleet_ledger(stats: Dict,
                        submitted: Optional[int] = None) -> Dict:
     """Aggregate the per-replica resilience ledgers out of a
@@ -1606,7 +1690,15 @@ def check_fleet_ledger(stats: Dict,
     failovers: a replica refused or shed work that another replica then
     served.  `balanced` is only asserted when every replica's stats
     were reachable (a killed replica cannot report, and a retired
-    process replica's counts die with its SIGTERM — `retired.lost`)."""
+    process replica's counts die with its SIGTERM — `retired.lost`).
+
+    ISSUE-16 satellite: within each reachable replica's planes, the
+    per-class (`priority`) and per-tenant (`tenants`) breakdowns must
+    also re-add to the plane's own totals; any drift lands in
+    `failures` (naming the replica, plane, and event) and clears
+    `balanced` — the /fleet/stats front turns a non-empty `failures`
+    list into a typed failure instead of serving corrupt accounting
+    with a 200."""
     agg = {"requests": 0, "rejected": 0, "shed": 0, "deadline_missed": 0,
            "poison_isolated": 0}
     retired = stats.get("retired") or {}
@@ -1614,6 +1706,7 @@ def check_fleet_ledger(stats: Dict,
         if k in agg:
             agg[k] += int(v or 0)
     reachable = int(retired.get("lost") or 0) == 0
+    failures: List[str] = []
     for entry in stats.get("replicas", ()):
         payload = entry.get("stats")
         if payload is None:
@@ -1621,11 +1714,14 @@ def check_fleet_ledger(stats: Dict,
                 reachable = False
             continue
         _fold_plane_counts(agg, payload)
+        _reconcile_breakdowns(str(entry.get("name") or "?"), payload,
+                              failures)
     fleet = stats.get("fleet", {})
     out = {"aggregate": agg, "replicas_reachable": reachable,
            "fleet_requests": int(fleet.get("requests") or 0),
-           "fleet_rejected": int(fleet.get("rejected") or 0)}
-    out["balanced"] = (reachable
+           "fleet_rejected": int(fleet.get("rejected") or 0),
+           "failures": failures}
+    out["balanced"] = (reachable and not failures
                        and agg["requests"] == out["fleet_requests"])
     if submitted is not None:
         out["submitted"] = int(submitted)
@@ -1686,7 +1782,25 @@ class _FleetHandler(ServingHTTPMixin, BaseHTTPRequestHandler):
             else:
                 self._json(200, {"ready": True})
         elif self.path == "/fleet/stats":
-            self._json(200, self.router.fleet_stats())
+            stats = self.router.fleet_stats()
+            failures = (stats.get("ledger") or {}).get("failures") or []
+            if failures:
+                # one re-poll before declaring drift: a snapshot cut
+                # between a plane counter and its breakdown ride-along
+                # can be off by one for an instant; REAL drift (an
+                # accounting site missing its label) survives the retry
+                stats = self.router.fleet_stats()
+                failures = (stats.get("ledger")
+                            or {}).get("failures") or []
+            if failures:
+                # drifting ledger = typed failure (ISSUE-16): corrupt
+                # accounting must not be served as a healthy 200 — the
+                # payload rides along so the operator can see WHERE
+                self._json(500, {"error": ("fleet ledger drift: "
+                                           + "; ".join(failures)),
+                                 "stats": stats})
+            else:
+                self._json(200, stats)
         elif self.path == "/serving/stats":
             # the cheap fleet-level view (no per-replica HTTP fan-out)
             self._json(200, self.router.fleet_stats(
@@ -1706,7 +1820,14 @@ class _FleetHandler(ServingHTTPMixin, BaseHTTPRequestHandler):
                     "fleet is draining: admission stopped")
             self._route_post(body)
         except FleetClientError as e:
-            self._json(e.status, {"error": str(e)})
+            # relay a replica's quota 429 with its Retry-After intact —
+            # the bucket deficit was computed where the tokens live
+            payload = {"error": str(e)}
+            headers = None
+            if e.retry_after_s is not None:
+                payload["retry_after_s"] = e.retry_after_s
+                headers = {"Retry-After": max(1, math.ceil(e.retry_after_s))}
+            self._json(e.status, payload, headers=headers)
         except Exception as e:  # noqa: BLE001 — the front must keep serving; unexpected -> 500 once, typed stay 4xx/503
             # typed serving failures map via the shared mixin
             # (UnservableShapeError -> 400, DeadlineExceededError -> 504,
@@ -1728,7 +1849,8 @@ class _FleetHandler(ServingHTTPMixin, BaseHTTPRequestHandler):
                 return
             probs = self.router.predict_proba(
                 feats, deadline_s=self._deadline_s(body),
-                request_id=self.request_id())
+                request_id=self.request_id(),
+                tenant=self._tenant(body))
             self._json(200, {
                 "predictions": np.argmax(probs, axis=-1).tolist(),
                 "outputs": np.asarray(probs).tolist()})
@@ -1759,7 +1881,8 @@ class _FleetHandler(ServingHTTPMixin, BaseHTTPRequestHandler):
                 deadline_s=self._deadline_s(body),
                 request_id=self.request_id(),
                 session_id=session_id,
-                priority=body.get("priority"))
+                priority=body.get("priority"),
+                tenant=self._tenant(body))
             self._json(200, payload)
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
@@ -1779,7 +1902,7 @@ class _FleetHandler(ServingHTTPMixin, BaseHTTPRequestHandler):
             beam_size=int(body.get("beam_size", 0)),
             deadline_s=self._deadline_s(body),
             request_id=self.request_id(), session_id=session_id,
-            priority=body.get("priority"))
+            priority=body.get("priority"), tenant=self._tenant(body))
         try:
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")

@@ -132,6 +132,26 @@ interactive until the ladder is exhausted; every transition is
 counted, traced and exposed (docs/robustness.md "The degradation
 ladder").
 
+**Multi-tenant traffic shaping** (ISSUE-16, `serving/tenancy.py`):
+with a `TenantRegistry` installed every request carries a `tenant`
+(default: the built-in unmetered ``default`` tenant, so registry-less
+deployments and pre-tenancy clients keep exact behavior).  Admission
+charges the request's token cost against the tenant's token bucket
+BEFORE the shared gate — an over-quota tenant gets a typed 429 whose
+Retry-After derives from its own bucket refill, and its refusals never
+consume the queue bound other tenants share.  The queue order becomes
+(priority rank, WFQ virtual finish time, arrival): priority still
+dominates absolutely; weighted-fair queuing only interleaves tenants
+WITHIN a class, and one tenant degenerates to the historic FIFO.  The
+brownout ladder's L3 preemption and L4 shed become tenant-aware: while
+any tenant is over quota or burning SLO budget, victims are taken from
+the worst offender first and a compliant tenant is never touched;
+without an offender the rungs keep their PR-15 global behavior.
+Per-tenant ledgers (tokens in/out, throttles, SLO burn rate) ride
+``/serving/stats`` under ``tenancy`` and Prometheus under the
+``serving_lm_tenant_*`` families (docs/robustness.md "Tenancy &
+SLOs").
+
 Resilience contract (ISSUE-4, mirrors `batcher.MicroBatcher`): bounded
 admission (`max_queue_depth` -> `ServingOverloadError`), per-request
 deadlines shed at the admitter before a prompt ever occupies a slot
@@ -186,6 +206,11 @@ from deeplearning4j_tpu.serving.resilience import (
     ServingUnavailableError,
     check_admission,
 )
+from deeplearning4j_tpu.serving.tenancy import (
+    DEFAULT_TENANT,
+    TenantQuotaError,
+    TenantRegistry,
+)
 from deeplearning4j_tpu.serving.transfer import (
     PageExport,
     PageShipError,
@@ -227,7 +252,8 @@ class _LMRequest:
                  "drafted", "accepted", "export", "export_result",
                  "import_pages", "stream", "session_id", "t_first",
                  "priority", "rank", "swap_key", "swap_restore",
-                 "swap_error", "stream_pushed", "preempted")
+                 "swap_error", "stream_pushed", "preempted",
+                 "tenant", "vft", "cost")
 
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
                  seed: int, deadline: Optional[float] = None,
@@ -263,6 +289,10 @@ class _LMRequest:
         self.swap_error: Optional[str] = None  # typed restore failure
         self.stream_pushed = 0             # tokens already streamed
         self.preempted = 0                 # times this lane was preempted
+        # multi-tenant traffic shaping (ISSUE-16)
+        self.tenant = DEFAULT_TENANT       # normalized tenant name
+        self.vft = 0.0                     # WFQ virtual finish time
+        self.cost = self.max_new + len(self.prompt)  # token cost charged
 
 
 class _Slot:
@@ -306,7 +336,7 @@ class ContinuousLMServer:
                  speculate: str = "off", draft_len: int = 4,
                  drafter=None, draft_model=None, ship: bool = False,
                  preempt: bool = False, swap_bytes: int = 64 << 20,
-                 brownout=None,
+                 brownout=None, tenants=None,
                  tracer: Optional[TraceRecorder] = None,
                  registry: Optional[MetricsRegistry] = None):
         if slots < 1:
@@ -424,6 +454,18 @@ class ContinuousLMServer:
             self._pressure = BrownoutLadder(brownout)
         else:
             self._pressure = BrownoutLadder()
+        # multi-tenant traffic shaping (ISSUE-16): None = tenancy off
+        # (zero behavioral change); a registry/dict/JSON turns on the
+        # quota meter, WFQ queue ordering and SLO-aware victim
+        # selection.  Meter charges and WFQ stamps happen under
+        # self._cond like every other admission mutation.
+        self.tenants = TenantRegistry.coerce(tenants)
+        # observed cadence of pressure-ladder updates (EWMA seconds):
+        # the Retry-After base for the L4 shed and the quota 429 —
+        # down_dwell calm updates at this cadence is the ladder's real
+        # exit timescale (ISSUE-16 satellite fix)
+        self._pressure_tick_s = 0.05
+        self._pressure_stamp: Optional[float] = None
         self._sessions: "collections.OrderedDict[str, int]" = (
             collections.OrderedDict())
         self._session_capacity = 1024
@@ -458,13 +500,28 @@ class ContinuousLMServer:
         per_req = (lat.get("p50_ms", 100.0) or 100.0) / 1e3
         return max(0.1, per_req * (1 + len(self._queue) / self.n_slots))
 
+    def _ladder_retry_after_locked(self) -> float:
+        """Retry-After for pressure-driven refusals (the L4 shed, and
+        the floor under a quota 429 while the ladder is up).  ISSUE-16
+        satellite fix: derived from the ladder's REAL exit timescale —
+        `down_dwell` consecutive calm updates at the observed update
+        cadence (EWMA, stamped by `_update_pressure_locked`) — instead
+        of the backlog constant, so clients back off proportionally to
+        how long the ladder actually needs to step down.  Falls back to
+        the backlog estimate when no ladder is installed."""
+        if self._pressure is None:
+            return self._retry_after_locked()
+        dwell = self._pressure.config.down_dwell * self._pressure_tick_s
+        return max(0.1, dwell)
+
     def _build_request(self, prompt_ids, max_new_tokens: int,
                        temperature: float, seed: int,
                        deadline_s: Optional[float],
                        request_id: Optional[str],
                        session_id: Optional[str] = None,
                        export: bool = False,
-                       priority: Optional[str] = None) -> _LMRequest:
+                       priority: Optional[str] = None,
+                       tenant: Optional[str] = None) -> _LMRequest:
         """Validate + construct one queue item — THE shared front half of
         `generate`/`generate_stream`/`prefill_export`/`admit_with_pages`.
         Export lanes are budgeted for their prefill pages only (they
@@ -499,6 +556,17 @@ class ContinuousLMServer:
         req.export = bool(export)
         req.priority = normalize_priority(priority)
         req.rank = PRIORITY_RANK[req.priority]
+        # tenant validation mirrors the priority gate: None -> the
+        # built-in default tenant, unknown -> ValueError (the front's
+        # 400).  Without a registry any explicit non-default tenant is
+        # unknown by definition.
+        if self.tenants is not None:
+            req.tenant = self.tenants.normalize(tenant)
+        elif tenant is not None and str(tenant) != DEFAULT_TENANT:
+            raise ValueError(
+                f"unknown tenant {str(tenant)!r}: no tenant registry "
+                f"is installed (serve -tenants, or "
+                f"ContinuousLMServer(tenants=...))")
         return req
 
     def _enqueue(self, req: _LMRequest) -> None:
@@ -511,19 +579,54 @@ class ContinuousLMServer:
         recovers.  A draining/stopped server is NOT accepting at all —
         that outranks the shed, so clients get the typed
         draining/unavailable error and fail over instead of retrying a
-        pool that will never admit again."""
+        pool that will never admit again.
+
+        The tenant quota gate (ISSUE-16) fires FIRST among the
+        accepting-state refusals: an over-quota tenant's 429s are the
+        CLIENT's budget, evaluated before the server-capacity shed and
+        the shared gate, so a flooding tenant's refusals never consume
+        the queue bound (and never dodge the meter by arriving while
+        the ladder is shedding)."""
         with self._cond:
+            if self._accepting and self.tenants is not None:
+                try:
+                    self.tenants.meter.charge(req.tenant, req.cost)
+                except TenantQuotaError as e:
+                    self.metrics.record_rejected()
+                    self.metrics.record_class("rejected", req.priority)
+                    self.metrics.record_tenant("rejected", req.tenant)
+                    self.metrics.record_tenant("throttled", req.tenant)
+                    # while the ladder is up, the bucket-refill retry is
+                    # floored at the ladder's exit timescale: tokens
+                    # refilling sooner than the pool recovers would
+                    # invite the flood straight back (satellite fix)
+                    if (self._pressure is not None
+                            and self._pressure.level > 0):
+                        e.retry_after_s = max(
+                            e.retry_after_s,
+                            self._ladder_retry_after_locked())
+                    raise
             if (self._accepting
                     and self._pressure is not None
                     and self._pressure.level >= 4
-                    and req.rank >= RANK_BEST_EFFORT):
+                    and req.rank >= RANK_BEST_EFFORT
+                    and not (self.tenants is not None
+                             and self.tenants.compliant(req.tenant)
+                             and self.tenants.any_offender())):
+                # tenant-aware shed (ISSUE-16): while a non-compliant
+                # tenant exists, a COMPLIANT tenant's best_effort still
+                # admits — the rung takes from the offender, never from
+                # a tenant inside its quota and SLO.  Without tenancy
+                # (or without an offender) the PR-15 global shed holds.
                 self.metrics.record_rejected()
                 self.metrics.record_class("rejected", req.priority)
+                if self.tenants is not None:
+                    self.metrics.record_tenant("rejected", req.tenant)
                 self.metrics.record_brownout_shed()
                 raise ServingOverloadError(
                     "brownout level 4: best_effort admission shed "
                     "while the KV pool recovers",
-                    retry_after_s=self._retry_after_locked())
+                    retry_after_s=self._ladder_retry_after_locked())
             try:
                 check_admission(
                     accepting=self._accepting, breaker=self.breaker,
@@ -535,28 +638,42 @@ class ContinuousLMServer:
                 # the shared gate already counted the rejection; the
                 # per-class ledger rides along (ISSUE-15)
                 self.metrics.record_class("rejected", req.priority)
+                if self.tenants is not None:
+                    self.metrics.record_tenant("rejected", req.tenant)
                 raise
             if not self._running:
                 self._start_locked()
             if req.session_id is not None:
                 self._note_session_locked(req.session_id)
+            if self.tenants is not None:
+                # WFQ stamp at admission: virtual finish time within
+                # the tenant's weighted share (ISSUE-16).  Stamped once
+                # — a preempted request re-inserts with its ORIGINAL
+                # vft, the WFQ analog of keeping the enqueue stamp.
+                req.vft = self.tenants.wfq.stamp(req.tenant, req.cost)
             self._queue_insert_locked(req)
             self.metrics.set_queue_depth(len(self._queue))
             self._cond.notify_all()
 
     def _queue_insert_locked(self, req: _LMRequest) -> None:
         """Priority-ordered insert: the queue is kept sorted by
-        (rank, enqueued) so `popleft` always yields the most important,
-        oldest request — one class degenerates to exactly the historic
-        FIFO.  A preempted request re-inserts with its ORIGINAL
-        enqueue stamp, so it lands ahead of later arrivals of its own
-        class instead of restarting at the back.  O(queue) insert; the
-        queue is bounded by `max_queue_depth`."""
-        key = (req.rank, req.enqueued)
+        (rank, vft, enqueued) so `popleft` always yields the most
+        important request, weighted-fairly across tenants within a
+        class (ISSUE-16), oldest-first as the tie-break.  Without a
+        tenant registry every vft is 0.0 and the key degenerates to the
+        PR-15 (rank, enqueued) sort; with ONE tenant the WFQ virtual
+        finish times are strictly increasing in arrival order, so one
+        class × one tenant is exactly the historic FIFO (pinned by
+        test).  A preempted request re-inserts with its ORIGINAL
+        enqueue stamp AND original vft, so it lands ahead of later
+        arrivals of its own class/tenant instead of restarting at the
+        back.  O(queue) insert; the queue is bounded by
+        `max_queue_depth`."""
+        key = (req.rank, req.vft, req.enqueued)
         i = len(self._queue)
         while i > 0:
             prev = self._queue[i - 1]
-            if (prev.rank, prev.enqueued) <= key:
+            if (prev.rank, prev.vft, prev.enqueued) <= key:
                 break
             i -= 1
         if i == len(self._queue):
@@ -596,6 +713,8 @@ class ContinuousLMServer:
                 self.metrics.set_queue_depth(len(self._queue))
                 self.metrics.record_shed()
                 self.metrics.record_class("shed", req.priority)
+                if self.tenants is not None:
+                    self.metrics.record_tenant("shed", req.tenant)
                 self._drop_swap_locked(req)
             except ValueError:
                 req.abandoned = True
@@ -612,6 +731,9 @@ class ContinuousLMServer:
             # deadline actually expired and the worker has not
             # already accounted it (mirror of MicroBatcher.submit)
             self.metrics.record_deadline_missed()
+            self.metrics.record_class("deadline_missed", req.priority)
+            if self.tenants is not None:
+                self.metrics.record_tenant("deadline_missed", req.tenant)
         self._trace_request(req, time.perf_counter(), status)
 
     def _wait(self, req: _LMRequest,
@@ -635,7 +757,8 @@ class ContinuousLMServer:
                  deadline_s: Optional[float] = None,
                  request_id: Optional[str] = None,
                  session_id: Optional[str] = None,
-                 priority: Optional[str] = None) -> List[int]:
+                 priority: Optional[str] = None,
+                 tenant: Optional[str] = None) -> List[int]:
         """prompt ids -> full sequence (prompt + generated), blocking.
 
         `timeout` bounds the client's wait; `deadline_s` (default
@@ -646,11 +769,13 @@ class ContinuousLMServer:
         affinity accounting.  `priority` (interactive/batch/best_effort,
         default interactive) orders admission and marks the lane's
         preemption class (docs/robustness.md "The degradation
-        ladder")."""
+        ladder").  `tenant` (default "default") names the registered
+        tenant charged for the request — quota 429s, WFQ ordering, and
+        SLO burn accounting key on it (ISSUE-16)."""
         req = self._build_request(prompt_ids, max_new_tokens, temperature,
                                   seed, deadline_s, request_id,
                                   session_id=session_id,
-                                  priority=priority)
+                                  priority=priority, tenant=tenant)
         self._enqueue(req)
         return self._wait(req, timeout)
 
@@ -660,7 +785,8 @@ class ContinuousLMServer:
                         deadline_s: Optional[float] = None,
                         request_id: Optional[str] = None,
                         session_id: Optional[str] = None,
-                        priority: Optional[str] = None
+                        priority: Optional[str] = None,
+                        tenant: Optional[str] = None
                         ) -> Iterator[int]:
         """Streaming `generate`: admission happens HERE (typed errors
         raise before a single byte of response is committed), then the
@@ -673,7 +799,7 @@ class ContinuousLMServer:
         req = self._build_request(prompt_ids, max_new_tokens, temperature,
                                   seed, deadline_s, request_id,
                                   session_id=session_id,
-                                  priority=priority)
+                                  priority=priority, tenant=tenant)
         req.stream = _queue.SimpleQueue()
         self._enqueue(req)
         return self._stream_tokens(req, timeout)
@@ -743,7 +869,8 @@ class ContinuousLMServer:
                        deadline_s: Optional[float] = None,
                        request_id: Optional[str] = None,
                        session_id: Optional[str] = None,
-                       priority: Optional[str] = None) -> PageExport:
+                       priority: Optional[str] = None,
+                       tenant: Optional[str] = None) -> PageExport:
         """Prefill-worker half of disaggregation: run the prompt through
         normal admission (radix reuse, chunked prefill, CoW) but resolve
         at prefill completion with the lane's shippable state — prompt
@@ -757,7 +884,7 @@ class ContinuousLMServer:
         req = self._build_request(prompt_ids, max_new_tokens, temperature,
                                   seed, deadline_s, request_id,
                                   session_id=session_id, export=True,
-                                  priority=priority)
+                                  priority=priority, tenant=tenant)
         self._enqueue(req)
         self._wait(req, timeout)
         return req.export_result
@@ -777,19 +904,28 @@ class ContinuousLMServer:
         if len(export.committed) >= export.max_new:
             # the prefill worker's first sample already filled the whole
             # budget (max_new == 1): nothing to decode — answer without
-            # occupying a slot or installing a page
+            # occupying a slot or installing a page.  Still a served
+            # request in EVERY ledger (plane, class, tenant) — the
+            # fleet reconciliation asserts they agree (ISSUE-16)
+            priority = normalize_priority(export.priority)
+            tenant = (self.tenants.normalize(export.tenant)
+                      if self.tenants is not None else DEFAULT_TENANT)
             with self._cond:
                 if export.session_id is not None:
                     self._note_session_locked(export.session_id)
             self.metrics.record_request(0.0)
             self.metrics.record_first_token(0.0)
+            self.metrics.record_class("requests", priority)
+            if self.tenants is not None:
+                self.metrics.record_tenant("requests", tenant)
             return (list(export.prompt)
                     + list(export.committed[:export.max_new]))
         req = self._build_request(export.prompt, export.max_new,
                                   export.temperature, export.seed,
                                   deadline_s, request_id,
                                   session_id=export.session_id,
-                                  priority=export.priority)
+                                  priority=export.priority,
+                                  tenant=export.tenant)
         req.import_pages = export
         self._enqueue(req)
         return self._wait(req, timeout)
@@ -941,6 +1077,8 @@ class ContinuousLMServer:
         for req in leftovers:
             self.metrics.record_shed()
             self.metrics.record_class("shed", req.priority)
+            if self.tenants is not None:
+                self.metrics.record_tenant("shed", req.tenant)
             req.error = ServingUnavailableError("LM server stopped")
             req.event.set()
 
@@ -1039,6 +1177,8 @@ class ContinuousLMServer:
                 if self._pressure is not None:
                     pres["brownout"] = self._pressure.stats()
                 out["pressure"] = pres
+            if self.tenants is not None:
+                out["tenancy"] = self.tenants.stats()
             if self.speculate != "off":
                 spec = {"mode": self.speculate,
                         "draft_len": self.draft_len,
@@ -1420,6 +1560,8 @@ class ContinuousLMServer:
             if slot.active and slot.req.abandoned:
                 self.metrics.record_shed()
                 self.metrics.record_class("shed", slot.req.priority)
+                if self.tenants is not None:
+                    self.metrics.record_tenant("shed", slot.req.tenant)
                 self._free_slot_pages(slot)
                 slot.req = None
         now = time.perf_counter()
@@ -1428,6 +1570,8 @@ class ContinuousLMServer:
             if req.abandoned:
                 shed += 1
                 self.metrics.record_class("shed", req.priority)
+                if self.tenants is not None:
+                    self.metrics.record_tenant("shed", req.tenant)
                 self._drop_swap_locked(req)
             elif req.deadline is not None and now >= req.deadline:
                 shed += 1
@@ -1435,6 +1579,10 @@ class ContinuousLMServer:
                 self.metrics.record_class("shed", req.priority)
                 self.metrics.record_class("deadline_missed",
                                           req.priority)
+                if self.tenants is not None:
+                    self.metrics.record_tenant("shed", req.tenant)
+                    self.metrics.record_tenant("deadline_missed",
+                                               req.tenant)
                 self._drop_swap_locked(req)
                 req.error = DeadlineExceededError(
                     f"deadline exceeded after {now - req.enqueued:.3f}s "
@@ -1459,9 +1607,13 @@ class ContinuousLMServer:
                 if plan is None:
                     break              # head-of-line waits for pages
                 req = self._queue.popleft()
+                if self.tenants is not None:
+                    self.tenants.wfq.advance(req.vft)
                 self._install_paged_locked(slot, req, plan)
             else:
                 slot.req = self._queue.popleft()
+                if self.tenants is not None:
+                    self.tenants.wfq.advance(slot.req.vft)
                 slot.req.t_installed = time.perf_counter()
                 slot.pos = 0
                 slot.fed = 0
@@ -1482,9 +1634,24 @@ class ContinuousLMServer:
         pages-free + queue depth in, level out; every transition is
         counted and published (ISSUE-15).  Ladder level 3 additionally
         preempts best_effort lanes PROACTIVELY — before the pool is
-        fully dry — whenever strictly higher-class work is waiting."""
+        fully dry — whenever strictly higher-class work is waiting;
+        with a tenant registry installed the rung takes lanes from
+        non-compliant (over-quota / SLO-burning) tenants FIRST and
+        leaves a compliant tenant's lanes alone whenever an offender
+        holds one (ISSUE-16)."""
         if self._pressure is None or self._pool is None:
             return
+        # observed update cadence (EWMA), the real timescale behind
+        # `down_dwell` exits — feeds `_ladder_retry_after_locked` so
+        # Retry-After tracks how fast this pool ACTUALLY re-evaluates
+        # pressure, not a constant (ISSUE-16 satellite fix)
+        now = time.perf_counter()
+        if self._pressure_stamp is not None:
+            dt = now - self._pressure_stamp
+            if 0.0 < dt < 5.0:
+                self._pressure_tick_s = (0.8 * self._pressure_tick_s
+                                         + 0.2 * dt)
+        self._pressure_stamp = now
         # pages-free counts evictable radix-cached pages too: a warm
         # prefix cache is reclaimable capacity, not pressure — without
         # this an idle pool with a full cache would sit degraded forever
@@ -1506,18 +1673,31 @@ class ContinuousLMServer:
         if (self._pressure.level >= 3 and self.preempt and self._queue
                 and self._queue[0].rank < RANK_BEST_EFFORT):
             head_rank = self._queue[0].rank
-            for slot in self._slots:
-                if (slot.active and not slot.req.abandoned
-                        and slot.req.rank >= RANK_BEST_EFFORT
-                        and slot.req.rank > head_rank):
-                    self._preempt_slot_locked(slot)
+            victims = [s for s in self._slots
+                       if (s.active and not s.req.abandoned
+                           and s.req.rank >= RANK_BEST_EFFORT
+                           and s.req.rank > head_rank)]
+            if (self.tenants is not None
+                    and any(not self.tenants.compliant(s.req.tenant)
+                            for s in victims)):
+                # offender-first rung (ISSUE-16): while a non-compliant
+                # tenant holds a candidate lane, preempt ONLY its lanes
+                # — a compliant tenant's best_effort survives L3
+                victims = [s for s in victims
+                           if not self.tenants.compliant(s.req.tenant)]
+            for slot in victims:
+                self._preempt_slot_locked(slot)
 
     def _preempt_one_locked(self, head: _LMRequest) -> bool:
         """Pick and preempt ONE victim so `head` can admit: the active
         lane with the worst (highest) rank strictly above the head's,
         ties broken newest-first so older work of the same class keeps
-        its progress.  Returns False when preemption is off, no program
-        pair exists yet, or nothing outranked is running."""
+        its progress.  With a tenant registry the WORST-BEHAVED tenant
+        pays first: victims sort by (over-quota, SLO burn rate) ahead
+        of the PR-15 (rank, enqueued) key, so an offender's lane swaps
+        out before a compliant tenant's ever does (ISSUE-16).  Returns
+        False when preemption is off, no program pair exists yet, or
+        nothing outranked is running."""
         if not self.preempt or self._gather is None or self._cache is None:
             return False
         victims = [s for s in self._slots
@@ -1525,7 +1705,13 @@ class ContinuousLMServer:
                    and s.req.rank > head.rank]
         if not victims:
             return False
-        victim = max(victims, key=lambda s: (s.req.rank, s.req.enqueued))
+        if self.tenants is not None:
+            victim = max(victims,
+                         key=lambda s: (self.tenants.badness(s.req.tenant),
+                                        s.req.rank, s.req.enqueued))
+        else:
+            victim = max(victims,
+                         key=lambda s: (s.req.rank, s.req.enqueued))
         self._preempt_slot_locked(victim)
         return True
 
@@ -1560,7 +1746,8 @@ class ContinuousLMServer:
                 committed=list(slot.generated), pos=int(slot.pos),
                 page_size=self.page_size, pages_k=pk, pages_v=pv,
                 model=model_signature(self.cfg, self.page_size),
-                session_id=req.session_id, priority=req.priority)
+                session_id=req.session_id, priority=req.priority,
+                tenant=req.tenant)
             blob = serialize_export(ex)
             key = f"swap-{self._swap_seq}"
             self._swap_seq += 1
@@ -1579,6 +1766,8 @@ class ContinuousLMServer:
                 # is counted (once), by _resolve_swap_locked
         req.preempted += 1
         self.metrics.record_preemption(req.priority)
+        if self.tenants is not None:
+            self.metrics.record_tenant("preempted", req.tenant)
         self._free_slot_pages(slot)
         slot.req = None
         slot.generated = []
@@ -1593,6 +1782,8 @@ class ContinuousLMServer:
             # discarded work, not a served request
             self.metrics.record_shed()
             self.metrics.record_class("shed", slot.req.priority)
+            if self.tenants is not None:
+                self.metrics.record_tenant("shed", slot.req.tenant)
         else:
             self.metrics.record_class("requests", slot.req.priority)
             slot.req.result = slot.req.prompt + slot.generated
@@ -1604,6 +1795,16 @@ class ContinuousLMServer:
                 now - slot.req.enqueued,
                 queue_wait_s=t_in - slot.req.enqueued,
                 compute_s=now - t_in)
+            if self.tenants is not None:
+                # the tenant's completion ledger: served count, tokens
+                # actually generated (tokens_out), and the SLO window
+                # sample that drives the burn-rate gauge (ISSUE-16)
+                tn = slot.req.tenant
+                self.metrics.record_tenant("requests", tn)
+                self.tenants.meter.record_out(tn, len(slot.generated))
+                self.tenants.slo.record(tn, now - slot.req.enqueued)
+                self.metrics.set_tenant_burn(
+                    tn, self.tenants.slo.burn_rate(tn))
             slot.req.event.set()
         self._free_slot_pages(slot)
         slot.req = None
@@ -1672,6 +1873,9 @@ class ContinuousLMServer:
                         self.metrics.record_shed()
                         self.metrics.record_class("shed",
                                                   s.req.priority)
+                        if self.tenants is not None:
+                            self.metrics.record_tenant("shed",
+                                                       s.req.tenant)
                         s.req.error = err
                         s.req.event.set()
                         self._free_slot_pages(s)
@@ -1814,7 +2018,8 @@ class ContinuousLMServer:
             committed=list(slot.generated), pos=int(slot.pos),
             page_size=self.page_size, pages_k=pk, pages_v=pv,
             model=model_signature(self.cfg, self.page_size),
-            session_id=req.session_id, priority=req.priority)
+            session_id=req.session_id, priority=req.priority,
+            tenant=req.tenant)
         self.metrics.record_ship("out", n, ex.nbytes(),
                                  time.perf_counter() - t0)
         req.export_result = ex
@@ -1996,6 +2201,8 @@ class ContinuousLMServer:
                     for r in victims:
                         self.metrics.record_shed()
                         self.metrics.record_class("shed", r.priority)
+                        if self.tenants is not None:
+                            self.metrics.record_tenant("shed", r.tenant)
                         r.error = ServingUnavailableError(
                             "LM server stopped")
                         r.event.set()

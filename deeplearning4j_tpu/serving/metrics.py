@@ -39,6 +39,13 @@ from deeplearning4j_tpu.serving.pressure import PRIORITY_CLASSES
 _CLASS_EVENTS = ("requests", "rejected", "shed", "deadline_missed",
                  "preempted")
 
+# the per-tenant traffic-shaping events (ISSUE-16): the per-class set
+# plus `throttled` (quota 429s — a tenant-only concept; priority
+# classes are never metered).  Tenant names are an OPEN vocabulary
+# fixed at serve time, so unlike `class_counters` the cells are
+# created lazily on first record (see `record_tenant`).
+_TENANT_EVENTS = _CLASS_EVENTS + ("throttled",)
+
 # breaker state -> gauge value (the exposition's numeric encoding;
 # the string stays in /serving/stats)
 _BREAKER_VALUES = {"closed": 0, "open": 1, "half_open": 2}
@@ -193,6 +200,15 @@ class ServingMetrics:
         self.brownout_shed_total = Counter(
             "serving_brownout_shed_total",
             "best_effort admissions refused by ladder level 4")
+        # multi-tenant ledger (ISSUE-16): tenant names are an OPEN
+        # vocabulary (fixed by the registry at serve time, unknown
+        # here), so the per-tenant cells are created lazily on first
+        # record and LATE-registered onto every registry this plane
+        # already published into — `register_into` remembers its
+        # (registry, labels) pairs for exactly that
+        self.tenant_counters: Dict = {}      # (event, tenant) -> Counter
+        self.tenant_burn_gauges: Dict = {}   # tenant -> Gauge
+        self._tenant_registrations: list = []
         # latency: end-to-end histogram + the queue-wait vs
         # dispatch-compute split (ISSUE-8 satellite — the batcher knows
         # both timestamps; before this they were collapsed into one
@@ -245,6 +261,13 @@ class ServingMetrics:
             registry.register(m, **labels)
         for (_event, cls), m in self.class_counters.items():
             registry.register(m, priority=cls, **labels)
+        with self._lock:
+            self._tenant_registrations.append((registry, dict(labels)))
+            tenant_cells = ([(tn, m) for (_e, tn), m
+                             in self.tenant_counters.items()]
+                            + list(self.tenant_burn_gauges.items()))
+        for tn, m in tenant_cells:
+            registry.register(m, tenant=tn, **labels)
         return self
 
     # ---- recording --------------------------------------------------------
@@ -358,6 +381,59 @@ class ServingMetrics:
         self._touch()
         self.preemptions_total.inc()
         self.record_class("preempted", priority)
+
+    def _tenant_counter(self, event: str, tenant: str) -> Counter:
+        key = (event, tenant)
+        c = self.tenant_counters.get(key)  # noqa: LCK101 — DCL fast path; creation is locked below
+        if c is None:
+            regs = None
+            with self._lock:
+                c = self.tenant_counters.get(key)
+                if c is None:
+                    c = Counter(f"serving_lm_tenant_{event}_total",
+                                f"LM {event} by tenant")
+                    regs = list(self._tenant_registrations)
+                    self.tenant_counters[key] = c
+            if regs is not None:
+                # publish outside the lock: registry.register takes the
+                # registry's own lock, and this cell is already visible
+                for registry, labels in regs:
+                    registry.register(c, tenant=tenant, **labels)
+        return c
+
+    def record_tenant(self, event: str, tenant: str, n: int = 1) -> None:
+        """Per-tenant traffic-shaping accounting (ISSUE-16): `event` is
+        one of requests/rejected/shed/deadline_missed/preempted/
+        throttled, mirroring `record_class` so the fleet ledger can
+        reconcile submitted == Σ tenants == Σ classes.  Cells
+        materialize on first use (`serving_lm_tenant_{event}_total`,
+        label ``tenant=``) and are published onto every registry this
+        plane registered into — accounting must never fail a request,
+        so like `record_class` this raises nothing on the record
+        path."""
+        self._tenant_counter(str(event), str(tenant)).inc(int(n))
+
+    def set_tenant_burn(self, tenant: str, value: float) -> None:
+        """Publish one tenant's SLO burn rate: the windowed fraction of
+        its requests over its latency target, divided by its error
+        budget — > 1.0 means the tenant is burning budget and is first
+        in line when the brownout ladder picks victims (ISSUE-16)."""
+        tenant = str(tenant)
+        g = self.tenant_burn_gauges.get(tenant)  # noqa: LCK101 — DCL fast path; creation is locked below
+        if g is None:
+            regs = None
+            with self._lock:
+                g = self.tenant_burn_gauges.get(tenant)
+                if g is None:
+                    g = Gauge("serving_lm_tenant_slo_burn_rate",
+                              "per-tenant SLO burn rate (>1 = burning "
+                              "error budget)")
+                    regs = list(self._tenant_registrations)
+                    self.tenant_burn_gauges[tenant] = g
+            if regs is not None:
+                for registry, labels in regs:
+                    registry.register(g, tenant=tenant, **labels)
+        g.set(float(value))
 
     def record_swap(self, direction: str, pages: int,
                     nbytes: int) -> None:
@@ -515,6 +591,21 @@ class ServingMetrics:
                 classes[cls] = vals
         if classes:
             out["priority"] = classes
+        # per-tenant ledger (ISSUE-16), same fire-once contract: the
+        # section appears only once some tenant has recorded an event
+        with self._lock:
+            tenant_cells = dict(self.tenant_counters)
+            burn_cells = dict(self.tenant_burn_gauges)
+        tenants: Dict = {}
+        for (event, tn), m in tenant_cells.items():
+            v = int(m.value)
+            if v:
+                tenants.setdefault(tn, {})[event] = v
+        for tn, g in burn_cells.items():
+            if tn in tenants:
+                tenants[tn]["burn_rate"] = round(float(g.value), 4)
+        if tenants:
+            out["tenants"] = tenants
         if int(self.preemptions_total.value):
             out["preemptions"] = int(self.preemptions_total.value)
         swaps = (int(self.swap_out_total.value)

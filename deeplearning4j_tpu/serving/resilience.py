@@ -57,6 +57,24 @@ class ServingUnavailableError(ServingError):
         self.retry_after_s = float(retry_after_s)
 
 
+class TenantQuotaError(ServingError):
+    """Admission refused because the request's TENANT is over its
+    token-rate quota (ISSUE-16) — raised by the tenancy meter BEFORE
+    the shared admission gate, so one flooding tenant's refusals never
+    consume the queue bound every tenant shares.  Maps to HTTP 429 +
+    Retry-After; ``retry_after_s`` is derived from the tenant's own
+    token-bucket refill (deficit / rate), never a constant — a client
+    backing off exactly as told finds tokens waiting.  Distinct from
+    `ServingOverloadError` (503) on purpose: 503 means the SERVER is
+    out of capacity (retry elsewhere), 429 means THIS CLIENT is out of
+    budget (slow down — a failover retry would be refused identically
+    on every replica sharing the registry)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
 class DeadlineExceededError(ServingError, TimeoutError):
     """The request's deadline passed before (or while) it could be
     served; expired work is shed *before* dispatch so timed-out clients
@@ -337,6 +355,29 @@ class ServingHTTPMixin:
                              f"number of milliseconds, got {raw!r}")
         return ms / 1e3
 
+    def _tenant(self, body) -> Optional[str]:
+        """Per-request tenant identity (ISSUE-16): the JSON ``tenant``
+        field wins, the ``X-Tenant`` header is the no-body-change
+        fallback — shared by the single-server front and the fleet
+        front (like `_deadline_s`) so clients write ONE payload shape.
+        Returns None when the client named no tenant (-> the default
+        tenant downstream); a malformed value is the client's 400.
+        UNKNOWN-tenant validation happens against the serving plane's
+        registry (`TenantRegistry.normalize`), so the 400 names the
+        registered vocabulary."""
+        tn = body.get("tenant") if isinstance(body, dict) else None
+        if tn is None:
+            tn = self.headers.get("X-Tenant")
+        if tn is None:
+            return None
+        if not isinstance(tn, (str, int)):
+            raise ValueError(
+                f"tenant must be a string, got {type(tn).__name__}")
+        tn = str(tn)
+        if not 0 < len(tn) <= 128:
+            raise ValueError("tenant must be 1..128 characters")
+        return tn
+
     def respond_typed_failure(self, e: BaseException) -> bool:
         """Map this module's typed serving failures to their promised
         status codes and answer the request; returns False (no response
@@ -352,6 +393,17 @@ class ServingHTTPMixin:
         if isinstance(e, DeadlineExceededError):
             # the request's deadline passed before it could be served
             self._json(504, {"error": str(e)})
+            return True
+        if isinstance(e, TenantQuotaError):
+            # the request's TENANT is over its token-rate quota: 429 +
+            # Retry-After from the bucket's own refill (ISSUE-16) —
+            # matched before the 503 clause because this is the
+            # client's budget, not the server's capacity
+            retry_after = max(1, math.ceil(
+                getattr(e, "retry_after_s", 1.0)))
+            self._json(429, {"error": str(e),
+                             "retry_after_s": retry_after},
+                       headers={"Retry-After": retry_after})
             return True
         if isinstance(e, (ServingOverloadError, ServingUnavailableError)):
             # admission refused (queue full / breaker open / draining):
@@ -377,6 +429,7 @@ __all__ = [
     "ServingHTTPServer",
     "ServingOverloadError",
     "ServingUnavailableError",
+    "TenantQuotaError",
     "UnservableShapeError",
     "check_admission",
 ]

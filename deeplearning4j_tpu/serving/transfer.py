@@ -97,6 +97,10 @@ class PageExport:
     # swapped lane keeps its priority on the pool it lands in; absent
     # in pre-ISSUE-15 frames -> interactive (the historical behavior)
     priority: str = "interactive"
+    # billing identity (ISSUE-16): same ride-along contract — a
+    # shipped or swapped lane stays charged to its tenant on the pool
+    # it lands in; absent in older frames -> the default tenant
+    tenant: str = "default"
 
     @property
     def n_pages(self) -> int:
@@ -134,6 +138,8 @@ def serialize_export(ex: PageExport) -> bytes:
         header["session_id"] = str(ex.session_id)
     if ex.priority != "interactive":
         header["priority"] = str(ex.priority)
+    if ex.tenant != "default":
+        header["tenant"] = str(ex.tenant)
     hj = json.dumps(header).encode()
     return MAGIC + struct.pack(">I", len(hj)) + hj + payload
 
@@ -195,7 +201,8 @@ def deserialize_export(data: bytes) -> PageExport:
         page_size=int(header["page_size"]),
         pages_k=pk, pages_v=pv, model=dict(header["model"]),
         session_id=header.get("session_id"),
-        priority=str(header.get("priority", "interactive")))
+        priority=str(header.get("priority", "interactive")),
+        tenant=str(header.get("tenant", "default")))
 
 
 def check_compatible(ex: PageExport, cfg, page_size: int,

@@ -442,9 +442,15 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
                 return
             try:
                 deadline_s = self._deadline_s(body)
+                tenant = self._tenant(body)
                 x = np.asarray(feats, np.float32)
+                # an unknown tenant raises ValueError from the
+                # batcher's registry normalize -> 400 here; an
+                # over-quota tenant raises TenantQuotaError -> the
+                # typed 429 + Retry-After mapping in do_POST
                 probs = engine.predict_proba(x, deadline_s=deadline_s,
-                                             request_id=self.request_id())
+                                             request_id=self.request_id(),
+                                             tenant=tenant)
             except (ValueError, TypeError) as e:
                 self._json(400, {"error": str(e)})
                 return
@@ -505,6 +511,21 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
             )
 
             priority = normalize_priority(body.get("priority"))
+            # billing identity (ISSUE-16): validated HERE against the
+            # pool's registry so an unknown tenant is a 400 naming the
+            # registered vocabulary on EVERY decode path — including
+            # the whole-sequence beam/top-k legs that never reach the
+            # continuous pool's own normalize
+            tenant = self._tenant(body)
+            if tenant is not None:
+                reg = (lm_server.tenants if lm_server is not None
+                       else None)
+                if reg is not None:
+                    tenant = reg.normalize(tenant)
+                elif tenant != "default":
+                    raise ValueError(
+                        f"unknown tenant {tenant!r}: no tenant "
+                        f"registry is installed (serve -tenants)")
             ids_list = validate_request(cfg, prompt, max_new)
             if temperature < 0:
                 raise ValueError(f"temperature must be >= 0, "
@@ -574,7 +595,7 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
                     ids_list, max_new, temperature=temperature,
                     seed=seed, deadline_s=deadline_s,
                     request_id=self.request_id(), session_id=session_id,
-                    priority=priority)
+                    priority=priority, tenant=tenant)
                 self._sse_stream(gen, ids_list)
                 return
             if (lm_server is not None and top_k == 0 and top_p >= 1.0):
@@ -585,7 +606,8 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
                                          seed=seed, deadline_s=deadline_s,
                                          request_id=self.request_id(),
                                          session_id=session_id,
-                                         priority=priority)
+                                         priority=priority,
+                                         tenant=tenant)
                 self._json(200, {"ids": ids})
                 return
             import jax
@@ -616,6 +638,10 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
         if not 0 < len(sid) <= 128:
             raise ValueError("session_id must be 1..128 characters")
         return sid
+
+    # _tenant (the JSON-field / X-Tenant extraction) lives on
+    # ServingHTTPMixin, shared with the fleet front so the two HTTP
+    # tenant contracts cannot drift (ISSUE-16)
 
     def _sse_stream(self, gen, prompt_ids: List[int]) -> None:
         """Relay one token stream as Server-Sent Events: one `data:`
@@ -705,7 +731,8 @@ class _Handler(ServingHTTPMixin, BaseHTTPRequestHandler):
                 deadline_s=self._deadline_s(body),
                 request_id=self.request_id(),
                 session_id=self._session_id(body),
-                priority=body.get("priority"))
+                priority=body.get("priority"),
+                tenant=self._tenant(body))
         except (ValueError, TypeError) as e:
             self._json(400, {"error": str(e)})
             return
@@ -791,7 +818,7 @@ class UiServer:
                  prefill_chunk: int = 8, speculate: str = "off",
                  draft_len: int = 4, ship: bool = False,
                  preempt: bool = False, swap_bytes: int = 64 << 20,
-                 brownout=None) -> "UiServer":
+                 brownout=None, tenants=None) -> "UiServer":
         """Register a TransformerLM for POST /lm/generate.  With
         `continuous` (default) greedy/temperature requests decode in a
         `slots`-lane continuous batching pool; `continuous=False` keeps
@@ -809,7 +836,11 @@ class UiServer:
         on priority preemption with host KV swap-out and `brownout`
         (True or a `PressureConfig`) the degradation ladder — the
         overload-survival plane (docs/robustness.md "The degradation
-        ladder")."""
+        ladder").  `tenants` (a `TenantRegistry`, spec mapping, or the
+        `-tenants` JSON text) installs the multi-tenant traffic-shaping
+        plane: per-tenant WFQ ordering, token-bucket quotas (429 +
+        Retry-After), and SLO burn-rate accounting (docs/robustness.md
+        "Tenancy & SLOs")."""
         lm_server = None
         if continuous:
             from deeplearning4j_tpu.serving import (
@@ -827,6 +858,7 @@ class UiServer:
                 prefill_chunk=prefill_chunk, speculate=speculate,
                 draft_len=draft_len, ship=ship, preempt=preempt,
                 swap_bytes=swap_bytes, brownout=brownout,
+                tenants=tenants,
                 tracer=self.state.tracer,
                 registry=self.state.registry)
         with self.state.lock:
@@ -844,14 +876,17 @@ class UiServer:
                     default_deadline_s: Optional[float] = None,
                     breaker_threshold: Optional[int] = 5,
                     breaker_cooldown_s: float = 1.0,
-                    quantize: Optional[str] = None) -> "UiServer":
+                    quantize: Optional[str] = None,
+                    tenants=None) -> "UiServer":
         """Register a MultiLayerNetwork behind the dynamic micro-batcher
         for POST /model/predict.  `warmup_example` (one example row) pre-
         compiles every bucket-ladder shape before traffic.
         `max_queue_depth`, `default_deadline_s` and the breaker knobs
         configure the serving-plane resilience layer; `quantize="int8"`
         serves per-channel int8 weights (precision plane,
-        docs/performance.md)."""
+        docs/performance.md); `tenants` installs the per-tenant quota
+        gate on the micro-batcher (ISSUE-16, docs/robustness.md
+        "Tenancy & SLOs")."""
         from deeplearning4j_tpu.serving import ServingEngine
 
         engine = ServingEngine(net, ladder=ladder, max_batch=max_batch,
@@ -862,7 +897,8 @@ class UiServer:
                                breaker_cooldown_s=breaker_cooldown_s,
                                quantize=quantize,
                                tracer=self.state.tracer,
-                               registry=self.state.registry)
+                               registry=self.state.registry,
+                               tenants=tenants)
         if warmup_example is not None:
             engine.warmup(warmup_example)
         with self.state.lock:

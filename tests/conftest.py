@@ -83,6 +83,13 @@ def pytest_configure(config):
         "chaos regression, role-aware autoscale signals (fast; run in "
         "tier-1)")
     config.addinivalue_line(
+        "markers", "tenancy: multi-tenant traffic shaping — tenant "
+        "registry/quota token buckets, WFQ ordering composed with "
+        "priority classes (one tenant == historic FIFO, pinned), "
+        "per-tenant 429s with honest Retry-After, burn-rate-driven "
+        "brownout victim selection, fleet ledger reconciliation "
+        "(fast; run in tier-1)")
+    config.addinivalue_line(
         "markers", "elastic: elastic checkpoint plane — sharded "
         "snapshots with SHA-256 integrity, two-phase atomic commit "
         "(kill -9 at every boundary), N→M topology-elastic restore, "

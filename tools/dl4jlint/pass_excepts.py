@@ -85,11 +85,19 @@ TRANSFER_PREFIX = "deeplearning4j_tpu/serving/transfer.py"
 PRESSURE_ALLOWLIST: dict = {}
 PRESSURE_PREFIX = "deeplearning4j_tpu/serving/pressure.py"
 
+# The tenancy policy plane (ISSUE-16) decides WHOSE request is
+# admitted, throttled, or sacrificed: a swallowed error here silently
+# over-bills or starves a tenant — no broad handlers at all, pragma'd
+# or not.  Same explicit-empty treatment as pressure.py.
+TENANCY_ALLOWLIST: dict = {}
+TENANCY_PREFIX = "deeplearning4j_tpu/serving/tenancy.py"
+
 # prefix -> (allowlist, label) for the strict-mode passes (first match
 # wins, so file-level prefixes go before their parent directory)
 STRICT_PREFIXES = (
     (TRANSFER_PREFIX, TRANSFER_ALLOWLIST, "TRANSFER_ALLOWLIST"),
     (PRESSURE_PREFIX, PRESSURE_ALLOWLIST, "PRESSURE_ALLOWLIST"),
+    (TENANCY_PREFIX, TENANCY_ALLOWLIST, "TENANCY_ALLOWLIST"),
     (SERVING_PREFIX, SERVING_ALLOWLIST, "SERVING_ALLOWLIST"),
     (OBS_PREFIX, OBS_ALLOWLIST, "OBS_ALLOWLIST"),
     (LAUNCHER_PREFIX, LAUNCHER_ALLOWLIST, "LAUNCHER_ALLOWLIST"),

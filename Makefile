@@ -6,7 +6,7 @@
 	bench-fleet bench-paged bench-procfleet test-obs bench-obs \
 	obs-smoke evidence lint test-lint test-elastic bench-elastic \
 	test-spec bench-spec test-disagg bench-disagg test-pressure \
-	bench-pressure test-tenancy bench-tenants
+	bench-pressure test-tenancy bench-tenants test-zero bench-zero
 
 # lint first: the four-pass static sweep is ~1s and fails fast on a
 # race/host-sync/recompile-hazard/broad-except finding before the
@@ -168,6 +168,17 @@ test-precision:
 # param-bytes reduction, parity guards (docs/performance.md).
 bench-precision:
 	BENCH_ONLY=precision python bench.py
+
+# ZeRO-1 weight-update sharding plane only (sharded-vs-replicated fp32
+# bitwise parity, loss-scale lockstep, chunked/local-SGD/clip-norm
+# composition, hybrid+pipeline DP-axis moments, elastic N->M resume,
+# zero-recompile guard).
+test-zero:
+	python -m pytest tests/ -q -m zero
+
+# The ZeRO leg rides the precision row (composed per-replica
+# train-state-bytes columns + the >=3.5x composed-reduction gate).
+bench-zero: bench-precision
 
 # Regenerate every committed EVIDENCE/ artifact (see EVIDENCE/README.md).
 # Each runner re-execs itself into a scrubbed 8-virtual-CPU-device env,

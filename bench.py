@@ -879,6 +879,13 @@ def bench_precision() -> dict:
       the acceptance bar is >=1.9x reduction.
     - PARITY leg: iris + lenet final-loss gap, bf16-mixed vs fp32,
       within the documented tolerance (docs/performance.md).
+    - ZERO leg (ISSUE-17): the ZeRO-1 weight-update sharding composed
+      with the precision plane — per-replica train_state_bytes columns
+      at N=2 under the sharding cost model (docs/performance.md "The
+      weight-update sharding cost model"): fp32-replicated vs fp32-ZeRO
+      vs bf16+ZeRO, composed reduction >=3.5x; fp32 sharded-vs-
+      replicated final loss bitwise; the `shard_update=False`
+      off-ladder still compiles and trains.
     - SERVING leg: `mnist_mlp` int8 vs fp32 — resident param bytes
       (>=3.5x bar), top-1 agreement (>=99% bar) and batched-forward
       latency for both.
@@ -941,6 +948,44 @@ def bench_precision() -> dict:
             "gap": round(gap, 5), "tolerance": tol,
             "within_tolerance": bool(gap <= tol)}
 
+    # ---- zero leg: ZeRO-1 update sharding x precision plane ------------
+    from deeplearning4j_tpu.parallel import DataParallelTrainer, make_mesh
+
+    n_zero = min(2, len(jax.devices()))
+    zmesh = make_mesh((n_zero,), ("data",),
+                      devices=jax.devices()[:n_zero])
+
+    def zero_run(policy: str, shard: bool, n_steps: int = 60):
+        znet = MultiLayerNetwork(iris_mlp()).init()   # adam: 16P fp32 state
+        znet.set_precision(policy)
+        tr = DataParallelTrainer(znet, mesh=zmesh, shard_update=shard)
+        for _ in range(n_steps):
+            loss = tr.fit_batch_async(ix, iyh)
+        return znet, float(loss)
+
+    net_rep, loss_rep = zero_run("fp32", shard=False)   # the off-ladder
+    net_z32, loss_z32 = zero_run("fp32", shard=True)
+    net_zbf, loss_zbf = zero_run("bf16", shard=True)
+    # Byte columns are the N=2 sharding COST MODEL (padded 1/N extents
+    # for params/moments/grads, scalars replicated) — device-count
+    # independent, so a 1-device host still reports the N=2 accounting.
+    zb_rep = int(net_rep.train_state_bytes())
+    zb_z32 = int(net_z32.train_state_bytes(shards=2))
+    zb_zbf = int(net_zbf.train_state_bytes(shards=2))
+    composed = zb_rep / zb_zbf
+    zero_leg = {
+        "model": "iris-mlp 4-16-16-3 adam", "replicas_modeled": 2,
+        "mesh_devices": n_zero,
+        "train_state_bytes_fp32_replicated": zb_rep,
+        "train_state_bytes_fp32_zero": zb_z32,
+        "train_state_bytes_bf16_zero": zb_zbf,
+        "composed_reduction": round(composed, 3),
+        "fp32_replicated_final_loss": round(loss_rep, 6),
+        "fp32_zero_final_loss": round(loss_z32, 6),
+        "bf16_zero_final_loss": round(loss_zbf, 6),
+        "fp32_shard_gap": abs(loss_rep - loss_z32),
+        "bf16_vs_fp32_gap": round(abs(loss_rep - loss_zbf), 5)}
+
     # ---- serving leg: mnist_mlp int8 vs fp32 ---------------------------
     net = MultiLayerNetwork(mnist_mlp()).init()
     sy = rng.integers(0, 10, 512)
@@ -982,11 +1027,21 @@ def bench_precision() -> dict:
         "int8_param_reduction_pass": bool(fp32_bytes / int8_bytes >= 3.5),
         "top1_agreement_min": 0.99,
         "top1_agreement_pass": bool(agree >= 0.99),
-        "parity_pass": all(p["within_tolerance"] for p in parity.values())}
+        "parity_pass": all(p["within_tolerance"] for p in parity.values()),
+        # ZeRO leg (ISSUE-17): bf16+ZeRO per-replica state vs
+        # fp32-replicated at N=2; fp32 sharded == replicated exactly
+        # (same reduction tree); bf16 loss gap within the pure-bf16
+        # tolerance; the shard_update=False off-ladder still trains.
+        "zero_composed_reduction_min": 3.5,
+        "zero_composed_reduction_pass": bool(composed >= 3.5),
+        "zero_fp32_bitwise_pass": bool(zero_leg["fp32_shard_gap"] == 0.0),
+        "zero_loss_gap_max": 0.25,
+        "zero_loss_gap_pass": bool(zero_leg["bf16_vs_fp32_gap"] <= 0.25),
+        "zero_off_ladder_pass": bool(np.isfinite(loss_rep))}
     return {"metric": "Precision plane: bf16-mixed train-state reduction",
             "unit": "x", "value": round(mem_reduction, 3),
-            "train": legs, "parity": parity, "serving": serving,
-            "guards": guards,
+            "train": legs, "parity": parity, "zero": zero_leg,
+            "serving": serving, "guards": guards,
             "meets_acceptance": all(v for k, v in guards.items()
                                     if k.endswith("_pass"))}
 

@@ -154,6 +154,10 @@ def cmd_train(args) -> int:
 
         from deeplearning4j_tpu.parallel import DataParallelTrainer, make_mesh
         sync_every = int(props.get("train.sync.every", args.sync_every))
+        shard_update = str(props.get(
+            "train.shard.update",
+            getattr(args, "shard_update", "on"))).lower() not in (
+                "off", "false", "0")
         if sync_every > 1:
             # local-SGD / Hogwild-router analog: replicas step on their
             # own shard and average every N steps instead of every step
@@ -173,8 +177,11 @@ def cmd_train(args) -> int:
                              devices=avail[:args.replicas])
             print(f"spmd: elastic mesh over {args.replicas} of "
                   f"{len(avail)} visible devices")
-        runner = DataParallelTrainer(net, mesh=mesh, sync_every=sync_every)
+        runner = DataParallelTrainer(net, mesh=mesh, sync_every=sync_every,
+                                     shard_update=shard_update)
         divisor = runner.n_devices
+        if not shard_update:
+            print("spmd: -shard-update off — replicated pmean updates")
     else:
         if args.replicas is not None:
             print("-replicas is an spmd-runtime flag; ignored under "
@@ -1180,6 +1187,16 @@ def build_parser() -> argparse.ArgumentParser:
                          help="spmd runtime: average replicas every N "
                               "steps instead of every step (local-SGD / "
                               "Hogwild-router analog; 1 = sync SGD)")
+    p_train.add_argument("-shard-update", "--shard-update",
+                         choices=("on", "off"), default="on",
+                         help="spmd runtime: ZeRO-1 weight-update "
+                              "sharding — reduce-scatter grads, step "
+                              "1/N of the flat parameter plane per "
+                              "replica, all-gather (default on; "
+                              "bitwise-equal to the replicated update "
+                              "and ~1/N the optimizer-state bytes per "
+                              "replica; 'off' restores the replicated "
+                              "pmean update)")
     p_train.add_argument("-replicas", "--replicas", type=int,
                          default=None,
                          help="spmd runtime: data-parallel over the "

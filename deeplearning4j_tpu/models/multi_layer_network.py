@@ -209,6 +209,16 @@ class MultiLayerNetwork:
                 "overflow_count":
                     int(self._scaler_state["overflow_count"])}
 
+    def train_state_bytes(self, x=None, mask=None, shards: int = 1) -> int:
+        """Per-replica training-state residency under the precision
+        policy; ``shards`` applies the ZeRO-1 weight-update sharding
+        cost model (docs/performance.md "The weight-update sharding
+        cost model") — `DataParallelTrainer.train_state_bytes` passes
+        its data-axis size here."""
+        from deeplearning4j_tpu.precision.policy import train_state_bytes
+
+        return train_state_bytes(self, x, mask, shards=shards)
+
     # ---- construction -----------------------------------------------------
 
     @classmethod

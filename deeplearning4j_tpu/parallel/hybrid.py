@@ -35,7 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel import transformer as tfm
 from deeplearning4j_tpu.parallel.data_parallel import shard_map
-from deeplearning4j_tpu.parallel.pipeline import gpipe_apply
+from deeplearning4j_tpu.parallel.pipeline import gpipe_apply, zero1_flat_update
 
 
 def _sgd_tree(params, grads, lr):
@@ -148,12 +148,18 @@ class HybridParallelTrainer:
     `updater` selects any ops.updaters transform ('sgd' keeps the
     historical exact-SGD behavior; 'adam' is the realistic pretraining
     choice).  Optimizer state is elementwise per parameter, so GSPMD
-    shards it exactly like the parameter it moments."""
+    shards it exactly like the parameter it moments — and, with
+    `shard_update=True` (the default, matching DataParallelTrainer's
+    ZeRO-1 plane), each moment leaf additionally shards its first free,
+    `data`-divisible dimension over the data axis: the update math is
+    elementwise, so XLA partitions the optimizer step across the dp
+    axis and each replica persists only 1/N of the moments (arXiv
+    2004.13336 expressed the GSPMD way — placement, not collectives)."""
 
     def __init__(self, cfg: tfm.TransformerConfig, mesh: Mesh,
                  lr: float = 1e-2, seed: int = 0,
                  axes: tfm.MeshAxes = tfm.MeshAxes(),
-                 updater: str = "sgd"):
+                 updater: str = "sgd", shard_update: bool = True):
         from deeplearning4j_tpu.ops.updaters import (
             UpdaterConfig,
             apply_updates,
@@ -164,6 +170,7 @@ class HybridParallelTrainer:
         self.mesh = mesh
         self.lr = lr
         self.axes = axes
+        self.shard_update = bool(shard_update)
         self._pspecs = tfm.param_specs(cfg, axes.model)
         self.params = place_params(
             mesh, _master_f32(tfm.init_params(cfg, jax.random.PRNGKey(seed))),
@@ -171,6 +178,11 @@ class HybridParallelTrainer:
         transform = make_updater(UpdaterConfig(
             updater=updater, learning_rate=lr, epsilon=1e-8))
         self.opt_state = transform.init(self.params)
+        self._opt_specs = (self._zero1_opt_specs() if self.shard_update
+                           else None)
+        if self._opt_specs is not None:
+            self.opt_state = place_params(mesh, self.opt_state,
+                                          self._opt_specs)
         cfg_, mesh_, axes_ = cfg, mesh, axes
         compute_dtype = jnp.dtype(cfg.dtype)
 
@@ -184,7 +196,75 @@ class HybridParallelTrainer:
             updates, opt_state = transform.update(grads, opt_state, params)
             return apply_updates(params, updates), opt_state, loss
 
-        self._step = jax.jit(step, donate_argnums=(0, 1))
+        if self._opt_specs is not None:
+            # Pin the output placements: without the constraint XLA's
+            # sharding propagation may resolve the moment-vs-gradient
+            # conflict by replicating the new moments, silently undoing
+            # the ZeRO placement after the first step.
+            out_sh = (self._shardings(self._pspecs, self.params),
+                      self._shardings(self._opt_specs, self.opt_state),
+                      NamedSharding(mesh, P()))
+            self._step = jax.jit(step, donate_argnums=(0, 1),
+                                 out_shardings=out_sh)
+        else:
+            self._step = jax.jit(step, donate_argnums=(0, 1))
+
+    def _shardings(self, spec_tree, tree):
+        """A NamedSharding pytree matching `tree` from a spec pytree in
+        either vocabulary (same flattening discipline as place_params)."""
+        from deeplearning4j_tpu.parallel import partition as part_lib
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        specs = jax.tree_util.tree_flatten(
+            spec_tree,
+            is_leaf=lambda x: isinstance(x, (P, part_lib.PartitionSpec)))[0]
+        assert len(leaves) == len(specs), (len(leaves), len(specs))
+        return jax.tree_util.tree_unflatten(
+            treedef, [NamedSharding(self.mesh, part_lib.as_jax_leaf(s))
+                      for s in specs])
+
+    def _zero1_opt_specs(self):
+        """Per-leaf specs for the optimizer state under ZeRO-1: moment
+        trees mirror the param specs with the data axis added on the
+        first free dimension whose size divides by the dp degree; leaves
+        that don't mirror the params (the shared "step" counter)
+        replicate.  A moment with no divisible free dim keeps its
+        param placement — correct, just not sharded (the remainder
+        rule here is whole-leaf, unlike the flat plane's padding)."""
+        import numpy as np
+
+        from deeplearning4j_tpu.parallel import partition as part_lib
+
+        n_data = dict(zip(self.mesh.axis_names,
+                          self.mesh.devices.shape))[self.axes.data]
+
+        def zspec(spec, shape):
+            spec = part_lib.as_jax_leaf(spec)
+            used = {ax for e in spec if e is not None
+                    for ax in (e if isinstance(e, tuple) else (e,))}
+            if self.axes.data in used or n_data <= 1:
+                return spec
+            entries = list(spec) + [None] * (len(shape) - len(spec))
+            for d, e in enumerate(entries):
+                if e is None and shape[d] and shape[d] % n_data == 0:
+                    entries[d] = self.axes.data
+                    return P(*entries)
+            return spec
+
+        p_leaves, p_def = jax.tree_util.tree_flatten(self.params)
+        pspec_leaves = jax.tree_util.tree_flatten(
+            self._pspecs,
+            is_leaf=lambda x: isinstance(x, (P, part_lib.PartitionSpec)))[0]
+        specs = {}
+        for key, sub in self.opt_state.items():
+            leaves, sdef = jax.tree_util.tree_flatten(sub)
+            if sdef == p_def:
+                specs[key] = jax.tree_util.tree_unflatten(
+                    sdef, [zspec(s, np.shape(a))
+                           for s, a in zip(pspec_leaves, leaves)])
+            else:
+                specs[key] = P()
+        return specs
 
     def fit_batch_async(self, tokens, targets):
         """One SPMD step; returns the loss as a DEVICE array without
@@ -215,7 +295,7 @@ class PipelineParallelTrainer:
     def __init__(self, cfg: tfm.TransformerConfig, mesh: Mesh,
                  n_microbatches: int = 4, lr: float = 1e-2, seed: int = 0,
                  data_axis: str = "data", stage_axis: str = "stage",
-                 updater: str = "sgd"):
+                 updater: str = "sgd", shard_update: bool = True):
         if cfg.n_experts:
             # Documented boundary (PARITY): MoE rides the dp/sp/tp/ep
             # mesh (HybridParallelTrainer); pipeline stages here are
@@ -260,11 +340,46 @@ class PipelineParallelTrainer:
 
         self._transform = make_updater(UpdaterConfig(
             updater=updater, learning_rate=lr, epsilon=1e-8))
-        # Optimizer state mirrors the params it moments (zeros_like
-        # preserves sharding: stage accumulators shard over `stage`,
-        # io accumulators replicate); the shared "step" scalar replicates.
-        self.stage_opt = self._transform.init(self.stage_params)
-        self.io_opt = self._transform.init(self.io_params)
+        self.shard_update = bool(shard_update)
+        self.n_data = dict(zip(mesh.axis_names,
+                               mesh.devices.shape))[data_axis]
+        if self.shard_update:
+            # ZeRO-1 over the data axis, flat-plane form (same layout as
+            # DataParallelTrainer / partition.zero1): per update plane a
+            # FLAT f32 vector padded to the data degree.  Stage moments
+            # are [n_stages, padded_extent] placed P(stage, data) — each
+            # worker persists its stage's 1/n_data slice; io moments are
+            # [padded_extent_io] placed P(data).
+            from deeplearning4j_tpu.parallel.partition import padded_extent
+            import numpy as np
+
+            self._k0_stage = sum(
+                int(np.prod(np.shape(a)))
+                for a in jax.tree_util.tree_leaves(self.stage_params)
+            ) // n_stages
+            self._pe_stage = padded_extent(self._k0_stage, self.n_data)
+            self._k0_io = sum(
+                int(np.prod(np.shape(a)))
+                for a in jax.tree_util.tree_leaves(self.io_params))
+            self._pe_io = padded_extent(self._k0_io, self.n_data)
+            stage_flat = self._transform.init(
+                {"p": jnp.zeros((n_stages, self._pe_stage), jnp.float32)})
+            io_flat = self._transform.init(
+                {"p": jnp.zeros((self._pe_io,), jnp.float32)})
+            self.stage_opt = place_params(
+                mesh, stage_flat,
+                {key: (P() if key == "step" else P(stage_axis, data_axis))
+                 for key in stage_flat})
+            self.io_opt = place_params(
+                mesh, io_flat,
+                {key: (P() if key == "step" else P(data_axis))
+                 for key in io_flat})
+        else:
+            # Optimizer state mirrors the params it moments (zeros_like
+            # preserves sharding: stage accumulators shard over `stage`,
+            # io accumulators replicate); the "step" scalar replicates.
+            self.stage_opt = self._transform.init(self.stage_params)
+            self.io_opt = self._transform.init(self.io_params)
         self._step = self._build_step()
 
     def _stage_fn(self, stage_params, x):
@@ -286,11 +401,25 @@ class PipelineParallelTrainer:
         stage_fn = self._stage_fn
         transform = self._transform
         compute_dtype = jnp.dtype(self.cfg.dtype)
+        shard_zero = self.shard_update
         # shard_map prefix-specs for the optimizer states: accumulator
         # subtrees follow their params' spec; the step counter replicates.
-        stage_opt_spec = {key: (P() if key == "step" else P(stage_axis))
-                          for key in self.stage_opt}
-        io_opt_spec = P()
+        # Under ZeRO-1 the flat moment planes additionally split over the
+        # data axis (stage moments [n_stages, pe] -> P(stage, data); io
+        # moments [pe_io] -> P(data)).
+        if shard_zero:
+            stage_opt_spec = {
+                key: (P() if key == "step" else P(stage_axis, data_axis))
+                for key in self.stage_opt}
+            io_opt_spec = {key: (P() if key == "step" else P(data_axis))
+                           for key in self.io_opt}
+            n_data = self.n_data
+            k0_st, pe_st = self._k0_stage, self._pe_stage
+            k0_io, pe_io = self._k0_io, self._pe_io
+        else:
+            stage_opt_spec = {key: (P() if key == "step" else P(stage_axis))
+                              for key in self.stage_opt}
+            io_opt_spec = P()
 
         n_stages = self.n_stages
         k = -(-m // n_stages)          # ceil: per-stage microbatch share
@@ -337,6 +466,42 @@ class PipelineParallelTrainer:
             loss, (g_stage, g_io) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1))(stage_params, io_params)
             inv = 1.0 / n_stages
+            loss = lax.pmean(loss, data_axis)
+            if shard_zero:
+                from jax.flatten_util import ravel_pytree
+
+                didx = lax.axis_index(data_axis)
+                # stage plane: flatten the LOCAL stage's grads/params
+                # (leaves [1, ...] under the stage in_spec), pad, and run
+                # one ZeRO-1 round over the data axis.  The 1/n_stages
+                # factor rides the flat gradient; psum_scatter/n is
+                # bitwise pmean's reduction tree, so this path matches
+                # the replicated update exactly.
+                flat_g, _ = ravel_pytree(g_stage)
+                flat_g = jnp.pad(flat_g * inv, (0, pe_st - k0_st))
+                flat_p, unravel = ravel_pytree(stage_params)
+                flat_p = jnp.pad(flat_p, (0, pe_st - k0_st))
+                opt_local = jax.tree_util.tree_map(
+                    lambda a: a[0] if a.ndim == 2 else a, stage_opt)
+                new_flat, opt_local = zero1_flat_update(
+                    transform, opt_local, flat_g, flat_p, data_axis,
+                    n_data, didx, k0_st)
+                new_stage = unravel(new_flat)
+                stage_opt = jax.tree_util.tree_map(
+                    lambda a: a[None] if a.ndim == 1 else a, opt_local)
+                # io plane: stage-partial grads sum across stages first,
+                # then the same flat round over data.
+                flat_gio, _ = ravel_pytree(g_io)
+                flat_gio = jnp.pad(
+                    lax.psum(flat_gio, stage_axis) * inv,
+                    (0, pe_io - k0_io))
+                flat_pio, unravel_io = ravel_pytree(io_params)
+                flat_pio = jnp.pad(flat_pio, (0, pe_io - k0_io))
+                new_flat_io, io_opt = zero1_flat_update(
+                    transform, io_opt, flat_gio, flat_pio, data_axis,
+                    n_data, didx, k0_io)
+                return (new_stage, unravel_io(new_flat_io),
+                        stage_opt, io_opt, loss)
             # stage params: per-shard grads are n_stages x own-slice grad.
             g_stage = jax.tree_util.tree_map(
                 lambda g: lax.pmean(g * inv, data_axis), g_stage)
@@ -346,7 +511,6 @@ class PipelineParallelTrainer:
             g_io = jax.tree_util.tree_map(
                 lambda g: lax.pmean(lax.psum(g, stage_axis) * inv,
                                     data_axis), g_io)
-            loss = lax.pmean(loss, data_axis)
             up_stage, stage_opt = transform.update(g_stage, stage_opt,
                                                    stage_params)
             up_io, io_opt = transform.update(g_io, io_opt, io_params)

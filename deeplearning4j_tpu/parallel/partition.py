@@ -97,6 +97,18 @@ def sharded(axis: str = "data", dim: int = 0,
     return PartitionSpec(axis=axis, dim=int(dim), size=size)
 
 
+def zero1(axis: str = "data", size: Optional[int] = None) -> PartitionSpec:
+    """The ZeRO-1 weight-update layout (arXiv 2004.13336): a FLAT
+    [padded_extent(k0, n)] moment/master vector split dim-0 over the
+    replica axis, each replica owning exactly one 1/N slice.  `size`
+    records the PADDED flat length (already a multiple of the axis
+    size), so split/join round-trips are trivially exact.  Identical
+    placement to `sharded(axis, dim=0, size=size)` — the dedicated name
+    is the vocabulary word every ZeRO consumer (DP trainer, pipeline DP
+    axis, checkpoint manifests) shares."""
+    return sharded(axis, dim=0, size=size)
+
+
 def is_partition_spec(obj) -> bool:
     return isinstance(obj, PartitionSpec)
 

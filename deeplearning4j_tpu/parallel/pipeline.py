@@ -29,6 +29,34 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def zero1_flat_update(transform, opt_local, flat_g, flat_p,
+                      axis_name: str, n: int, idx, k0: int):
+    """One ZeRO-1 weight-update round over `axis_name` on a FLAT plane
+    (arXiv 2004.13336) — the shard_map-side twin of
+    `DataParallelTrainer._build_sharded_update_step`, shared by the
+    pipeline trainer's stage and io update planes.
+
+    flat_g / flat_p: the local replica's full flat gradient / parameter
+    vector, already padded to `padded_extent(k0, n)` (padding lanes zero)
+    and already carrying any pre-reduction scaling (e.g. the pipeline's
+    1/n_stages factor).  opt_local: the transform state over this
+    replica's {"p": [pe // n]} slice.  The reduce happens as
+    `psum_scatter(flat_g)/n` — bitwise the same reduction tree as
+    `pmean` — each replica steps only its slice, and `all_gather` (with
+    the padding stripped) rebuilds the full vector.
+
+    Returns (new_flat_p [k0], new_opt_local).
+    """
+    from deeplearning4j_tpu.ops.updaters import apply_updates
+
+    ksh = flat_g.shape[0] // n
+    g_sh = lax.psum_scatter(flat_g, axis_name, tiled=True) / n
+    p_sh = lax.dynamic_slice_in_dim(flat_p, idx * ksh, ksh)
+    up, opt_local = transform.update({"p": g_sh}, opt_local, {"p": p_sh})
+    new_sh = apply_updates({"p": p_sh}, up)["p"]
+    return lax.all_gather(new_sh, axis_name, tiled=True)[:k0], opt_local
+
+
 def gpipe_apply(stage_fn: Callable, stage_params, x_local: jax.Array,
                 axis_name: str, n_microbatches: int,
                 remat_stage: bool = True) -> jax.Array:

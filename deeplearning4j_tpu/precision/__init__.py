@@ -27,6 +27,7 @@ from deeplearning4j_tpu.precision.loss_scale import (  # noqa: F401
     LossScaleConfig,
     grads_finite,
     init_scaler_state,
+    shard_update_finite,
     unscale_grads,
     update_scaler_state,
     where_tree,
@@ -54,7 +55,8 @@ __all__ = [
     "PrecisionPolicy", "resolve_policy", "cast_floating", "default_dtype",
     "param_bytes", "train_state_bytes", "activation_bytes", "tree_bytes",
     "LossScaleConfig", "DynamicLossScaler", "init_scaler_state",
-    "grads_finite", "unscale_grads", "update_scaler_state", "where_tree",
+    "grads_finite", "shard_update_finite", "unscale_grads",
+    "update_scaler_state", "where_tree",
     "QuantizedNet", "quantize_symmetric", "dequantize", "int8_dense",
     "int8_conv", "quantize_net_params",
 ]

@@ -87,6 +87,26 @@ def grads_finite(grads: PyTree):
     return ok
 
 
+def shard_update_finite(g_shard, loss, axis: str):
+    """Lockstep finiteness verdict for the ZeRO-1 sharded update step.
+
+    Each replica sees only its 1/N flat gradient slice, so local
+    `grads_finite` answers would diverge across replicas — one would
+    skip, another would step, and params desynchronize forever.  Instead
+    psum the LOCAL non-finite count over the data axis and AND it with
+    the (already pmean'd, hence identical) loss's finiteness: every
+    replica computes the SAME verdict, so overflow skips stay in
+    lockstep.  Also guards the psum_scatter itself: a non-finite value
+    produced by the scatter's summation lands in exactly one shard, and
+    the cross-replica count catches it where a local check could not."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    bad = jnp.sum((~jnp.isfinite(
+        jnp.asarray(g_shard).astype(jnp.float32))).astype(jnp.int32))
+    return jnp.logical_and(lax.psum(bad, axis) == 0, jnp.isfinite(loss))
+
+
 def unscale_grads(grads: PyTree, scale) -> PyTree:
     """grads / scale, preserving each leaf's dtype (one reciprocal, then
     a broadcast multiply per leaf — cheap next to the backward)."""

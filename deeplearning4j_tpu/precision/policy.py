@@ -187,8 +187,8 @@ def activation_bytes(net, x, mask=None) -> int:
     return sum(int(np.prod(np.shape(a))) * itemsize for a in acts)
 
 
-def train_state_bytes(net, x=None, mask=None) -> int:
-    """The steady-state training-memory model of one step:
+def train_state_bytes(net, x=None, mask=None, shards: int = 1) -> int:
+    """The steady-state PER-REPLICA training-memory model of one step:
 
         master params (param_dtype) + optimizer state (as held)
         + gradients (compute_dtype, one per param)
@@ -196,14 +196,51 @@ def train_state_bytes(net, x=None, mask=None) -> int:
 
     This is the quantity the bf16-mixed policy halves: master weights
     stay fp32, but gradients and activations — which dominate at real
-    batch sizes — shrink to 2 bytes each."""
+    batch sizes — shrink to 2 bytes each.
+
+    ``shards > 1`` applies the ZeRO-1 weight-update sharding cost model
+    (arXiv 2004.13336; docs/performance.md "The weight-update sharding
+    cost model"): params, optimizer moments and gradients all count at
+    their padded 1/N extent — `padded_extent(k, N) // N` elements per
+    replica — because each replica PERSISTS only its flat slice of the
+    update plane; the gathered full parameters are a transient of the
+    forward, already represented by the activation term, and scalar
+    leaves (step counters) stay replicated.  Activations never shard
+    (each replica runs the full forward on its batch slice)."""
+    from deeplearning4j_tpu.parallel.partition import padded_extent
+
     params = net.params if net.params is not None else []
     n_params = sum(int(np.prod(np.shape(a)))
                    for p in params for a in p.values())
-    total = tree_bytes(params)
-    if net.updater_state is not None:
-        total += tree_bytes(net.updater_state)
-    total += n_params * np.dtype(net.precision.compute_dtype).itemsize
+    shards = max(1, int(shards))
+
+    def frac(num_bytes: int, n_elems: int) -> int:
+        """Per-replica bytes of an n_elems-element extent under the
+        padded-remainder rule (num_bytes spread over n_elems)."""
+        if shards == 1 or n_elems == 0:
+            return num_bytes
+        per = padded_extent(n_elems, shards) // shards
+        return int(round(num_bytes * per / n_elems))
+
+    total = frac(tree_bytes(params), n_params)
+    upd = net.updater_state
+    if upd is None:
+        owner = getattr(net, "_updater_state_owner", None)
+        if owner is not None:
+            # A live shard_update trainer holds the moments; publish a
+            # per-layer view so the accounting sees them.
+            owner.sync_updater_state_to_net()
+            upd = net.updater_state
+    if upd is not None:
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(upd):
+            n = int(np.prod(np.shape(leaf)))
+            b = n * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+            # scalar automaton/step leaves replicate; moment vectors shard
+            total += b if n <= 1 else frac(b, n)
+    total += frac(
+        n_params * np.dtype(net.precision.compute_dtype).itemsize, n_params)
     if x is not None:
         total += activation_bytes(net, x, mask)
     return total

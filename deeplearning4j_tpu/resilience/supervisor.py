@@ -408,9 +408,10 @@ class TrainingSupervisor:
     def _supports_chunks(self) -> bool:
         """A runner takes the fused-chunk path only when its
         `fit_chunk_async` actually works: DataParallelTrainer exposes the
-        method in every mode but raises for local-SGD/shard_update."""
+        method in every mode but raises for local-SGD (the sharded
+        ZeRO-1 default threads its shard-local optimizer state through
+        the scan carry and chunks fine)."""
         return (hasattr(self.runner, "fit_chunk_async")
-                and not getattr(self.runner, "shard_update", False)
                 and getattr(self.runner, "sync_every", 1) == 1)
 
     def _snapshot_train_state(self):
@@ -612,8 +613,8 @@ class TrainingSupervisor:
         if k > 1:
             log.warning(
                 "chunk_size=%s requested but %s has no fused chunk path "
-                "(local-SGD / shard_update trainers carry per-mode state "
-                "the scan cannot thread); supervising per-step", k,
+                "(local-SGD trainers carry per-replica state the scan "
+                "cannot thread); supervising per-step", k,
                 type(self.runner).__name__)
         if not self._has_checkpoint():
             self.checkpoint(score=None)  # rollback anchor before step 1

@@ -58,6 +58,13 @@ def pytest_configure(config):
         "hygiene (real processes via the stdlib stub worker; fast, "
         "run in tier-1 — full `dl4j serve` worker spawns are `slow`)")
     config.addinivalue_line(
+        "markers", "zero: ZeRO-1 weight-update sharding plane — "
+        "sharded-vs-replicated fp32 bitwise parity, mixed-precision "
+        "loss-scale lockstep under the scatter, chunked-fit/local-SGD/"
+        "clip-norm/lr-multiplier composition, hybrid+pipeline DP-axis "
+        "moment sharding, elastic N-to-M resume, zero-recompile guard "
+        "(fast; run in tier-1)")
+    config.addinivalue_line(
         "markers", "lint: dl4jlint static-analysis gates — per-pass "
         "fixtures, baseline workflow, the zero-new-findings sweep over "
         "the real tree (pure AST, no jax; fast, run in tier-1)")

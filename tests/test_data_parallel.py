@@ -128,24 +128,43 @@ class TestShardedWeightUpdate:
             shard_shapes = {s.data.shape for s in a.addressable_shards}
             assert shard_shapes == {(k // n,)}, shard_shapes
 
-    def test_shard_update_rejects_local_sgd(self):
+    def test_local_sgd_keeps_sharded_sync_round(self):
+        """shard_update composes with local-SGD now: replicas keep local
+        replicated moments; the sync round runs the flat sharded
+        param-average (see scaling_report)."""
         from deeplearning4j_tpu.models import iris_mlp
 
         net = MultiLayerNetwork(iris_mlp()).init()
-        with pytest.raises(ValueError, match="shard_update"):
-            DataParallelTrainer(net, sync_every=4, shard_update=True)
+        tr = DataParallelTrainer(net, sync_every=4, shard_update=True)
+        assert tr.shard_update and tr.sync_every == 4
+        assert "sharded sync round" in tr.scaling_report()["collective"]
 
-    def test_rejects_global_norm_clip(self):
+    def test_global_norm_clip_shards(self):
+        """clip_norm composes with the sharded update: the global norm
+        is assembled from shard-local partial square-norms (one psum),
+        matching the replicated update to float tolerance."""
         import dataclasses
 
         from deeplearning4j_tpu.models import iris_mlp
 
         conf = iris_mlp()
         conf = dataclasses.replace(
-            conf, conf=dataclasses.replace(conf.conf, clip_norm=1.0))
-        net = MultiLayerNetwork(conf).init()
-        with pytest.raises(ValueError, match="clip_norm"):
-            DataParallelTrainer(net, shard_update=True)
+            conf, conf=dataclasses.replace(conf.conf, clip_norm=0.5))
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((16, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+
+        def run(shard):
+            net = MultiLayerNetwork(conf).init()
+            tr = DataParallelTrainer(net, shard_update=shard)
+            for _ in range(3):
+                tr.fit_batch(x, y)
+            tr.finalize()
+            return np.concatenate([np.asarray(l).ravel() for l in
+                                   jax.tree_util.tree_leaves(net.params)])
+
+        np.testing.assert_allclose(run(True), run(False),
+                                   rtol=0, atol=1e-6)
 
     def test_finalize_publishes_and_new_trainer_resumes_exactly(self):
         """Contract: during sharded training the TRAINER owns the opt

@@ -32,6 +32,9 @@ def test_resume_on_smaller_mesh(tmp_path):
     big = DataParallelTrainer(net, mesh=make_mesh((8,), ("data",)))
     for _ in range(5):
         big.fit_batch(x, y)
+    # Under the sharded default the TRAINER owns the optimizer state;
+    # publish the per-layer view into the net before checkpointing.
+    big.publish_train_state()
     save_checkpoint(tmp_path, step=5, params=net.params,
                     updater_state=net.updater_state)
     loss_before = float(big.fit_batch(x, y))

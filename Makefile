@@ -6,7 +6,8 @@
 	bench-fleet bench-paged bench-procfleet test-obs bench-obs \
 	obs-smoke evidence lint test-lint test-elastic bench-elastic \
 	test-spec bench-spec test-disagg bench-disagg test-pressure \
-	bench-pressure test-tenancy bench-tenants test-zero bench-zero
+	bench-pressure test-tenancy bench-tenants test-zero bench-zero \
+	test-paged-kernel bench-paged-kernel
 
 # lint first: the four-pass static sweep is ~1s and fails fast on a
 # race/host-sync/recompile-hazard/broad-except finding before the
@@ -50,6 +51,18 @@ test-paged:
 # (docs/performance.md "The KV memory cost model").
 bench-paged:
 	BENCH_ONLY=paged python bench.py
+
+# Paged-attention KERNEL plane only (fused block-table-walk flash
+# attention: kernel-vs-gather-oracle parity incl. C>1 chunks, page
+# straddles, null lanes, bf16/fp16 finite masks, serving byte-parity,
+# zero-recompile guard — docs/performance.md "The paged-attention
+# kernel cost model").
+test-paged-kernel:
+	python -m pytest tests/ -q -m paged_kernel
+
+# The kernel leg rides the paged row (kernel-vs-gather decode-step wall
+# time + modeled HBM bytes/step columns and the live-pages acceptance).
+bench-paged-kernel: bench-paged
 
 # Speculative-decode tests only (drafter plane: n-gram property suite +
 # small-model drafter, wide verify with in-jit accept/rollback, greedy

@@ -1720,6 +1720,62 @@ def bench_paged_kv() -> dict:
     finally:
         paged.stop()
 
+    # ---- kernel-vs-gather decode-step column (ISSUE-18) -------------------
+    # One 1-wide decode dispatch at a representative post-prefill depth,
+    # timed on both `_paged_attn` paths, plus the modeled K/V HBM bytes
+    # each reads: the gather path touches every block-table row (MP*ps
+    # pool rows per lane per layer), the fused kernel only live pages.
+    # The storm above rode the default path, so this column never moves
+    # the row's wall time; on CPU the kernel leg runs in Pallas
+    # interpret mode and its ms value measures the interpreter, not the
+    # TPU win — the bytes model is the backend-independent signal.
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel.generation import (
+        init_paged_cache,
+        make_paged_step,
+    )
+    from deeplearning4j_tpu.parallel.paged_kernel import paged_hbm_bytes
+
+    total = half_pages + 1
+    depth = sys_len + 3                    # every decode starts here
+    live_pages = depth // ps + 1
+    iters = 20 if on_tpu else 3
+
+    def _decode_step_ms(kernel_on: bool) -> float:
+        step = make_paged_step(cfg, total, ps, 1,
+                               paged_kernel=kernel_on)
+        cache = init_paged_cache(cfg, total, ps)
+        k, v = cache["k"], cache["v"]
+        table = np.zeros((slots, max_pages), np.int32)
+        for b in range(slots):
+            table[b, :live_pages] = 1 + (
+                b * live_pages + np.arange(live_pages)) % half_pages
+        args = (jnp.asarray(table),
+                jnp.full((slots,), depth, jnp.int32),
+                jnp.ones((slots,), jnp.int32),
+                jnp.zeros((slots, 1), jnp.int32),
+                jnp.zeros((slots,), jnp.float32),
+                jnp.zeros((slots,), jnp.int32),
+                jnp.zeros((slots,), jnp.int32))
+        nxt, k, v = step(params, k, v, *args)      # compile + warm
+        nxt.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            nxt, k, v = step(params, k, v, *args)
+        nxt.block_until_ready()
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    gather_ms = _decode_step_ms(False)
+    kernel_ms = _decode_step_ms(True)
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    bytes_gather = paged_hbm_bytes(
+        cfg.n_layers, slots, live_pages, max_pages, ps, cfg.n_heads,
+        cfg.head_dim, itemsize, kernel=False)
+    bytes_kernel = paged_hbm_bytes(
+        cfg.n_layers, slots, live_pages, max_pages, ps, cfg.n_heads,
+        cfg.head_dim, itemsize, kernel=True)
+
     toks = n_req * new
     speedup = round(sec_dense / sec_paged, 2)
     kv_ratio = round(dense_stats["kv_bytes"]["provisioned"]
@@ -1750,6 +1806,18 @@ def bench_paged_kv() -> dict:
             "ttft_p99_ms": paged_stats.get("ttft", {}).get("p99_ms"),
             "compiled_programs": paged_stats["compiled_programs"],
             "off_ladder_compiles": len(compiles),
+            "kernel_decode_step_ms": round(kernel_ms, 3),
+            "gather_decode_step_ms": round(gather_ms, 3),
+            "kernel_vs_gather_wall": round(gather_ms / kernel_ms, 2),
+            "kernel_live_pages": live_pages,
+            "kernel_backend": ("compiled" if on_tpu
+                               else "pallas-interpret"),
+            "hbm_bytes_per_step_gather": bytes_gather,
+            "hbm_bytes_per_step_kernel": bytes_kernel,
+            "hbm_bytes_kernel_vs_gather": round(
+                bytes_kernel / bytes_gather, 3),
+            "meets_kernel_acceptance": bool(
+                bytes_kernel * max_pages <= bytes_gather * live_pages),
             "meets_acceptance": bool(
                 (speedup >= 2.0 or (kv_ratio >= 2.0 and speedup >= 1.2))
                 and (hit_rate or 0) > 0.5 and not compiles),

@@ -21,6 +21,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deeplearning4j_tpu.parallel.kernels import mask_value
+from deeplearning4j_tpu.parallel.paged_kernel import (
+    paged_flash_attention,
+    resolve_paged_kernel,
+)
 from deeplearning4j_tpu.parallel.transformer import (
     TransformerConfig,
     _layer_norm,
@@ -56,7 +61,7 @@ def _cached_attn(p, x, layer_k, layer_v, pos):
     s = jnp.einsum("bqhk,bshk->bqhs", q, layer_k) / jnp.sqrt(
         jnp.asarray(d, q.dtype))
     valid = jnp.arange(layer_k.shape[1]) <= pos          # [max_len]
-    s = jnp.where(valid[None, None, None, :], s, -1e30)
+    s = jnp.where(valid[None, None, None, :], s, mask_value(s.dtype))
     w = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bqhs,bshk->bqhk", w, layer_v)
     return out_proj(p, o), layer_k, layer_v
@@ -223,7 +228,7 @@ def _slot_attn(p, x, layer_k, layer_v, pos):
     s = jnp.einsum("bqhk,bshk->bqhs", q, layer_k) / jnp.sqrt(
         jnp.asarray(d, q.dtype))
     valid = jnp.arange(layer_k.shape[1])[None, :] <= pos[:, None]  # [B, S]
-    s = jnp.where(valid[:, None, None, :], s, -1e30)
+    s = jnp.where(valid[:, None, None, :], s, mask_value(s.dtype))
     w = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bqhs,bshk->bqhk", w, layer_v)
     return out_proj(p, o), layer_k, layer_v
@@ -336,7 +341,8 @@ def init_paged_cache(cfg: TransformerConfig, pages: int,
             "v": jnp.zeros((cfg.n_layers,) + shape, dt)}
 
 
-def _paged_attn(p, x, layer_k, layer_v, table, pos, n_feed):
+def _paged_attn(p, x, layer_k, layer_v, table, pos, n_feed,
+                paged_kernel: bool = False):
     """Block-table paged attention for one layer.
 
     x: [B, C, d] (C = prefill chunk width; decode dispatches use C=1);
@@ -344,10 +350,21 @@ def _paged_attn(p, x, layer_k, layer_v, table, pos, n_feed):
     pos: [B] start positions; n_feed: [B] real columns this dispatch.
 
     Each lane scatters its fed tokens' k/v into its OWN pages (padding
-    columns and inactive lanes write the null page 0), then gathers its
-    logical history through the block table and runs exactly the dense
-    `_slot_attn` math over it — masked positions contribute exact zeros,
-    so outputs are byte-identical to the dense pool."""
+    columns and inactive lanes write the null page 0), then attends
+    over its logical history.  Two history paths share that scatter:
+
+    - ``paged_kernel=False`` — the gather ORACLE: materialize the full
+      ``[B, MP*ps, H, K]`` history through the block table and run
+      exactly the dense `_slot_attn` math over it; masked positions
+      contribute exact zeros, so outputs are byte-identical to the
+      dense pool.  Kept as the parity reference (and guarded against
+      re-growth by dl4jlint PGD301 — this is the baselined occurrence).
+    - ``paged_kernel=True`` — `paged_flash_attention` walks the block
+      table INSIDE the kernel: no contiguous history buffer, K/V
+      streamed page-by-page, beyond-``pos`` pages skipped, so HBM
+      traffic scales with live pages instead of ``MP*ps``.  Identical
+      math at every fed column (padding columns are never consumed).
+    """
     q, k, v = qkv_proj(p, x)                              # [B, C, H, K]
     b, c, h, kd = q.shape
     pages, ps = layer_k.shape[0], layer_k.shape[1]
@@ -364,6 +381,11 @@ def _paged_attn(p, x, layer_k, layer_v, table, pos, n_feed):
         k.reshape(b * c, h, kd))
     fv = layer_v.reshape(pages * ps, h, kd).at[idx].set(
         v.reshape(b * c, h, kd))
+    fk4 = fk.reshape(pages, ps, h, kd)
+    fv4 = fv.reshape(pages, ps, h, kd)
+    if paged_kernel:
+        o = paged_flash_attention(q, fk4, fv4, table, pos, n_feed)
+        return out_proj(p, o), fk4, fv4
     # gather each lane's logical history: [B, S, H, K], S = MP * ps
     gidx = (table[:, :, None] * ps
             + jnp.arange(ps)[None, None, :]).reshape(b, mp * ps)
@@ -371,16 +393,17 @@ def _paged_attn(p, x, layer_k, layer_v, table, pos, n_feed):
     s = jnp.einsum("bqhk,bshk->bqhs", q, hk) / jnp.sqrt(
         jnp.asarray(kd, q.dtype))
     causal = jnp.arange(mp * ps)[None, None, :] <= wpos[:, :, None]
-    s = jnp.where(causal[:, :, None, :], s, -1e30)      # [B, C, H, S]
+    s = jnp.where(causal[:, :, None, :], s,
+                  mask_value(s.dtype))                    # [B, C, H, S]
     w = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bqhs,bshk->bqhk", w, hv)
-    return (out_proj(p, o), fk.reshape(pages, ps, h, kd),
-            fv.reshape(pages, ps, h, kd))
+    return out_proj(p, o), fk4, fv4
 
 
 def paged_forward(cfg: TransformerConfig, params: dict, cache: dict,
                   table: jax.Array, pos: jax.Array, n_feed: jax.Array,
-                  tokens: jax.Array) -> Tuple[jax.Array, dict]:
+                  tokens: jax.Array,
+                  paged_kernel: bool = False) -> Tuple[jax.Array, dict]:
     """tokens: [B, C] int32, lane b feeding its first n_feed[b] columns
     at positions pos[b].. -> (logits [B, C, V] at EVERY fed column,
     cache with the fed k/v scattered into the page pool).
@@ -400,7 +423,8 @@ def paged_forward(cfg: TransformerConfig, params: dict, cache: dict,
         a, nk, nv = _paged_attn(layer["attn"],
                                 _layer_norm(layer["ln1"], x),
                                 cache["k"][i], cache["v"][i],
-                                table, pos, n_feed)
+                                table, pos, n_feed,
+                                paged_kernel=paged_kernel)
         ks.append(nk)
         vs.append(nv)
         x = x + a
@@ -414,11 +438,12 @@ def paged_forward(cfg: TransformerConfig, params: dict, cache: dict,
 
 def paged_decode_step(cfg: TransformerConfig, params: dict, cache: dict,
                       table: jax.Array, pos: jax.Array, n_feed: jax.Array,
-                      tokens: jax.Array) -> Tuple[jax.Array, dict]:
+                      tokens: jax.Array,
+                      paged_kernel: bool = False) -> Tuple[jax.Array, dict]:
     """`paged_forward` with logits taken at each lane's LAST fed column
     (-> [B, V]) — the chunked-prefill/decode entry point."""
     logits, cache = paged_forward(cfg, params, cache, table, pos, n_feed,
-                                  tokens)
+                                  tokens, paged_kernel=paged_kernel)
     last = jnp.take_along_axis(
         logits, jnp.maximum(n_feed - 1, 0)[:, None, None], axis=1)[:, 0]
     return last, cache
@@ -426,19 +451,24 @@ def paged_decode_step(cfg: TransformerConfig, params: dict, cache: dict,
 
 @functools.lru_cache(maxsize=16)
 def _compiled_paged_step(cfg: TransformerConfig, pages: int,
-                         page_size: int, chunk: int):
+                         page_size: int, chunk: int,
+                         paged_kernel: bool = False):
     """One jitted paged program per (config, pages, page_size, chunk):
     the pool shape and block-table width are baked in, the k/v buffers
     are donated, and sampling is the SAME device-side per-slot automaton
     as `_compiled_slot_step` (greedy/temperature, fold_in(seed, count))
-    so paged and dense lanes sample byte-identically."""
+    so paged and dense lanes sample byte-identically.  `paged_kernel`
+    arrives pre-resolved to a bool (see `resolve_paged_kernel`) so the
+    auto-detected default and an explicit matching flag share ONE cache
+    entry — the compile ladder keeps its size either way."""
 
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def step(params, cache_k, cache_v, table, pos, n_feed, tokens,
              temperature, seeds, counts):
         cache = {"k": cache_k, "v": cache_v}
         logits, cache = paged_decode_step(cfg, params, cache, table, pos,
-                                          n_feed, tokens)
+                                          n_feed, tokens,
+                                          paged_kernel=paged_kernel)
         logits = logits.astype(jnp.float32)
         greedy = jnp.argmax(logits, axis=-1)
         keys = jax.vmap(lambda s, c: jax.random.fold_in(
@@ -452,12 +482,16 @@ def _compiled_paged_step(cfg: TransformerConfig, pages: int,
 
 
 def make_paged_step(cfg: TransformerConfig, pages: int, page_size: int,
-                    chunk: int):
+                    chunk: int, paged_kernel: bool | None = None):
     """Compiled paged-step entry for `serving.lm.ContinuousLMServer`:
     fn(params, k, v, table [B, MP], pos [B], n_feed [B], tokens [B, C],
-    temperature [B], seeds [B], counts [B]) -> (next_token [B], k, v)."""
+    temperature [B], seeds [B], counts [B]) -> (next_token [B], k, v).
+
+    `paged_kernel=None` auto-resolves (fused block-table kernel on TPU,
+    gather oracle elsewhere; DL4J_TPU_PAGED_KERNEL overrides)."""
     return _compiled_paged_step(cfg, int(pages), int(page_size),
-                                int(chunk))
+                                int(chunk),
+                                resolve_paged_kernel(paged_kernel))
 
 
 # ---------------------------------------------------------------------------
@@ -484,7 +518,8 @@ def make_paged_step(cfg: TransformerConfig, pages: int, page_size: int,
 
 def spec_verify_step(cfg: TransformerConfig, params: dict, cache: dict,
                      table: jax.Array, pos: jax.Array, n_feed: jax.Array,
-                     n_draft: jax.Array, tokens: jax.Array
+                     n_draft: jax.Array, tokens: jax.Array,
+                     paged_kernel: bool = False
                      ) -> Tuple[jax.Array, jax.Array, dict]:
     """tokens: [B, W] int32; lane b feeds its first n_feed[b] columns.
     Two lane shapes are supported, and the accept mask assumes them:
@@ -506,7 +541,7 @@ def spec_verify_step(cfg: TransformerConfig, params: dict, cache: dict,
     produced there, so greedy parity is byte-exact and a sampled lane
     (n_draft = 0) sees precisely its last-fed column."""
     logits, cache = paged_forward(cfg, params, cache, table, pos, n_feed,
-                                  tokens)
+                                  tokens, paged_kernel=paged_kernel)
     logits = logits.astype(jnp.float32)                    # [B, W, V]
     pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B, W]
     w = tokens.shape[1]
@@ -525,7 +560,8 @@ def spec_verify_step(cfg: TransformerConfig, params: dict, cache: dict,
 
 @functools.lru_cache(maxsize=16)
 def _compiled_spec_step(cfg: TransformerConfig, pages: int,
-                        page_size: int, width: int):
+                        page_size: int, width: int,
+                        paged_kernel: bool = False):
     """One jitted speculative-verify program per (config, pages,
     page_size, width): forward + in-jit accept/rollback + the SAME
     per-slot sampling automaton as `_compiled_paged_step` applied at
@@ -537,7 +573,8 @@ def _compiled_spec_step(cfg: TransformerConfig, pages: int,
              tokens, temperature, seeds, counts):
         cache = {"k": cache_k, "v": cache_v}
         blog, accepted, cache = spec_verify_step(
-            cfg, params, cache, table, pos, n_feed, n_draft, tokens)
+            cfg, params, cache, table, pos, n_feed, n_draft, tokens,
+            paged_kernel=paged_kernel)
         greedy = jnp.argmax(blog, axis=-1)
         keys = jax.vmap(lambda s, c: jax.random.fold_in(
             jax.random.PRNGKey(s), c))(seeds, counts)
@@ -550,13 +587,15 @@ def _compiled_spec_step(cfg: TransformerConfig, pages: int,
 
 
 def make_spec_step(cfg: TransformerConfig, pages: int, page_size: int,
-                   width: int):
+                   width: int, paged_kernel: bool | None = None):
     """Compiled speculative-verify entry for the LM pool:
     fn(params, k, v, table [B, MP], pos [B], n_feed [B], n_draft [B],
     tokens [B, W], temperature [B], seeds [B], counts [B])
-    -> (bonus_token [B], accepted [B], k, v)."""
+    -> (bonus_token [B], accepted [B], k, v).  `paged_kernel=None`
+    auto-resolves exactly as in `make_paged_step`."""
     return _compiled_spec_step(cfg, int(pages), int(page_size),
-                               int(width))
+                               int(width),
+                               resolve_paged_kernel(paged_kernel))
 
 
 @functools.lru_cache(maxsize=16)

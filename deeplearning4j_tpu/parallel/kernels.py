@@ -35,6 +35,19 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) \
 
 NEG_INF = -1e30
 
+
+def mask_value(dtype) -> jax.Array:
+    """Finite large-negative mask constant for `dtype` softmax scores.
+
+    The hardcoded ``-1e30`` the masked-softmax paths used overflows to
+    ``-inf`` in fp16 (max ~6.5e4), so a fully masked row becomes
+    ``softmax(-inf - (-inf)) = NaN`` and poisons every downstream read.
+    ``finfo.min / 2`` is representable in every float dtype and still
+    underflows to exactly 0 through ``exp(s - max)``, so masked
+    positions contribute nothing while fully-masked rows stay finite.
+    """
+    return jnp.asarray(jnp.finfo(jnp.dtype(dtype)).min / 2, dtype)
+
 # Softmax row-stats (lse, delta) cross the pallas_call boundary in
 # LANE-REPLICATED form [B*H, S, REP]: Mosaic tiles VMEM blocks (8, 128)
 # over the last two dims, so a compact [B*H, S] array can never be

@@ -337,6 +337,7 @@ class ContinuousLMServer:
                  drafter=None, draft_model=None, ship: bool = False,
                  preempt: bool = False, swap_bytes: int = 64 << 20,
                  brownout=None, tenants=None,
+                 paged_kernel: Optional[bool] = None,
                  tracer: Optional[TraceRecorder] = None,
                  registry: Optional[MetricsRegistry] = None):
         if slots < 1:
@@ -380,6 +381,10 @@ class ContinuousLMServer:
             raise ValueError(
                 f"brownout requires kv='paged' (got kv={kv!r}): the "
                 f"ladder's signals are the paged pool's pressure")
+        if paged_kernel and kv != "paged":
+            raise ValueError(
+                f"paged_kernel=True requires kv='paged' (got kv={kv!r}):"
+                f" the fused kernel walks the block tables")
         self.cfg = cfg
         self.params = params
         self.n_slots = int(slots)
@@ -400,6 +405,15 @@ class ContinuousLMServer:
         if self.kv_pages < 1:
             raise ValueError(f"pages must be >= 1, got {self.kv_pages}")
         self.prefill_chunk = int(prefill_chunk)
+        # None = auto (fused block-table kernel on TPU, gather oracle
+        # elsewhere); resolved ONCE here so the ladder keys, stats and
+        # every make_*_step call agree for the server's lifetime
+        from deeplearning4j_tpu.parallel.paged_kernel import (
+            resolve_paged_kernel,
+        )
+
+        self.paged_kernel = (resolve_paged_kernel(paged_kernel)
+                             if kv == "paged" else False)
         self.speculate = speculate
         self.draft_len = int(draft_len)
         self._drafter = drafter            # built in _start_locked if None
@@ -1166,7 +1180,8 @@ class ContinuousLMServer:
                                    else self.kv_pages),
                     "radix_nodes": (self._tree.nodes
                                     if self._tree is not None else 0),
-                    "ship": self.ship})
+                    "ship": self.ship,
+                    "paged_kernel": self.paged_kernel})
             if self._sessions:
                 out["sessions_tracked"] = len(self._sessions)
             out["kv"] = kv
@@ -1278,17 +1293,20 @@ class ContinuousLMServer:
 
                 total = self.kv_pages + 1
                 self._decode_step = make_paged_step(
-                    self.cfg, total, self.page_size, 1)
+                    self.cfg, total, self.page_size, 1,
+                    paged_kernel=self.paged_kernel)
                 if self.speculate != "off":
                     # ONE wide program serves chunked prefill AND the
                     # speculative verify — the same chunked-feed ladder,
                     # widened to fit [last, d_1..d_draft_len]
                     self._chunk_step = make_spec_step(
-                        self.cfg, total, self.page_size, self.spec_width)
+                        self.cfg, total, self.page_size, self.spec_width,
+                        paged_kernel=self.paged_kernel)
                 else:
                     self._chunk_step = (make_paged_step(
                         self.cfg, total, self.page_size,
-                        self.prefill_chunk)
+                        self.prefill_chunk,
+                        paged_kernel=self.paged_kernel)
                         if self.prefill_chunk > 1 else None)
                 self._copy = make_page_copy(self.cfg, total,
                                             self.page_size)

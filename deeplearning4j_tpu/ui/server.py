@@ -815,6 +815,7 @@ class UiServer:
                  breaker_cooldown_s: float = 1.0,
                  kv: str = "paged", page_size: int = 16,
                  pages: Optional[int] = None,
+                 paged_kernel: Optional[bool] = None,
                  prefill_chunk: int = 8, speculate: str = "off",
                  draft_len: int = 4, ship: bool = False,
                  preempt: bool = False, swap_bytes: int = 64 << 20,
@@ -828,7 +829,9 @@ class UiServer:
         `page_size`, `pages` and `prefill_chunk` configure the paged KV
         pool with radix prefix reuse (docs/performance.md "The KV
         memory cost model"); `kv="dense"` keeps the original per-slot
-        dense cache.  `speculate` ("ngram"/"model") turns on
+        dense cache.  `paged_kernel` forces the fused paged-attention
+        decode kernel on/off (None: on when the backend is TPU —
+        docs/performance.md "The paged-attention kernel cost model").  `speculate` ("ngram"/"model") turns on
         speculative multi-token decode for greedy lanes with up to
         `draft_len` drafts per round (paged KV only; sampling lanes
         fall back to 1-token decode — docs/performance.md "The
@@ -855,6 +858,7 @@ class UiServer:
                 cfg, params, slots=slots, max_queue_depth=max_queue_depth,
                 default_deadline_s=default_deadline_s, breaker=breaker,
                 kv=kv, page_size=page_size, pages=pages,
+                paged_kernel=paged_kernel,
                 prefill_chunk=prefill_chunk, speculate=speculate,
                 draft_len=draft_len, ship=ship, preempt=preempt,
                 swap_bytes=swap_bytes, brownout=brownout,

@@ -102,6 +102,12 @@ def pytest_configure(config):
         "(kill -9 at every boundary), N→M topology-elastic restore, "
         "corruption fallback, crash-safe resume incl. a real training "
         "process killed mid-save (fast; run in tier-1)")
+    config.addinivalue_line(
+        "markers", "paged_kernel: Pallas paged-attention decode kernel "
+        "— fused block-table walk vs. the gather oracle (ragged "
+        "n_feed, page straddles, C>1 chunk/verify widths, null lanes, "
+        "random-shape sweep), dtype-aware mask constants, and the "
+        "serving-ladder zero-new-compiles guard (fast; run in tier-1)")
 
 
 @pytest.fixture

@@ -166,3 +166,335 @@ class TestFlashAttention:
                         jax.tree_util.tree_leaves(g0)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Paged-attention kernel (ISSUE-18): the fused block-table walk vs. the
+# gather oracle, plus the dtype-aware mask constant it rides on.
+
+from deeplearning4j_tpu.parallel.generation import (  # noqa: E402
+    _paged_attn,
+    init_paged_cache,
+    paged_forward,
+    spec_verify_step,
+)
+from deeplearning4j_tpu.parallel.kernels import mask_value  # noqa: E402
+from deeplearning4j_tpu.parallel.paged_kernel import (  # noqa: E402
+    paged_flash_attention,
+    paged_hbm_bytes,
+    resolve_paged_kernel,
+)
+
+
+def _paged_state(b, c, h, kd, ps, mp, pos, seed=0, dtype=jnp.float32):
+    """Random page-pool state: pool big enough for every lane's live
+    pages to be DISTINCT physical pages; block tables cover each lane
+    through pos+C-1 and point at the null page past it."""
+    rng = np.random.default_rng(seed)
+    pages = 1 + b * mp
+    q = jnp.asarray(rng.standard_normal((b, c, h, kd)), dtype)
+    kp = jnp.asarray(rng.standard_normal((pages, ps, h, kd)), dtype)
+    vp = jnp.asarray(rng.standard_normal((pages, ps, h, kd)), dtype)
+    table = np.zeros((b, mp), np.int32)
+    for i in range(b):
+        need = min(mp, (int(pos[i]) + c - 1) // ps + 1)
+        table[i, :need] = 1 + i * mp + np.arange(need)
+    return q, kp, vp, jnp.asarray(table), jnp.asarray(pos, jnp.int32)
+
+
+def _gather_oracle(q, kp, vp, table, pos):
+    """The `_paged_attn` gather path's attention math, verbatim: full
+    MP*ps history buffer + masked softmax."""
+    b, c, h, kd = q.shape
+    pages, ps = kp.shape[:2]
+    mp = table.shape[1]
+    gidx = (table[:, :, None] * ps
+            + jnp.arange(ps)[None, None, :]).reshape(b, mp * ps)
+    hk = kp.reshape(pages * ps, h, kd)[gidx]
+    hv = vp.reshape(pages * ps, h, kd)[gidx]
+    s = jnp.einsum("bqhk,bshk->bqhs", q, hk) / jnp.sqrt(
+        jnp.asarray(kd, q.dtype))
+    wpos = pos[:, None] + jnp.arange(c)[None, :]
+    causal = jnp.arange(mp * ps)[None, None, :] <= wpos[:, :, None]
+    s = jnp.where(causal[:, :, None, :], s, mask_value(s.dtype))
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqhs,bshk->bqhk", w, hv)
+
+
+def _assert_fed_columns_match(got, want, n_feed, atol=1e-5):
+    for i in range(got.shape[0]):
+        nf = int(n_feed[i])
+        if nf:
+            np.testing.assert_allclose(np.asarray(got)[i, :nf],
+                                       np.asarray(want)[i, :nf],
+                                       atol=atol)
+
+
+@pytest.mark.paged_kernel
+class TestPagedFlashAttention:
+    """Kernel-vs-gather parity at the attention level: the kernel must
+    reproduce the oracle's masked softmax at every FED column (padding
+    columns are never consumed by any caller)."""
+
+    def test_c1_decode_ragged_positions(self):
+        """C=1 decode with lanes at a page boundary, mid-page, the last
+        row of a page, and deep history — the decode dispatch shape."""
+        ps, mp = 4, 8
+        pos = np.array([0, 5, 3, 23], np.int32)
+        q, kp, vp, table, posj = _paged_state(4, 1, 2, 8, ps, mp, pos)
+        nf = jnp.ones((4,), jnp.int32)
+        got = paged_flash_attention(q, kp, vp, table, posj, nf)
+        want = _gather_oracle(q, kp, vp, table, posj)
+        _assert_fed_columns_match(got, want, nf)
+
+    def test_chunk_straddles_page_boundary(self):
+        """C>1 chunked feed whose write window crosses a page edge:
+        intra-chunk causal masking must match the oracle column by
+        column (the chunked-prefill / verify dispatch shape)."""
+        ps, mp, c = 4, 6, 5
+        pos = np.array([2, 3, 7], np.int32)     # straddle 1 and 2 pages
+        q, kp, vp, table, posj = _paged_state(3, c, 2, 8, ps, mp, pos,
+                                              seed=1)
+        nf = jnp.full((3,), c, jnp.int32)
+        got = paged_flash_attention(q, kp, vp, table, posj, nf)
+        want = _gather_oracle(q, kp, vp, table, posj)
+        _assert_fed_columns_match(got, want, nf)
+
+    def test_ragged_n_feed(self):
+        """Lanes feeding fewer than C columns (mixed chunk tails): every
+        fed column exact; padding columns are unconsumed by contract."""
+        ps, mp, c = 4, 6, 4
+        pos = np.array([9, 1, 14, 0], np.int32)
+        q, kp, vp, table, posj = _paged_state(4, c, 2, 8, ps, mp, pos,
+                                              seed=2)
+        nf = jnp.asarray([4, 2, 1, 3], jnp.int32)
+        got = paged_flash_attention(q, kp, vp, table, posj, nf)
+        want = _gather_oracle(q, kp, vp, table, posj)
+        _assert_fed_columns_match(got, want, nf)
+
+    def test_null_page_lane(self):
+        """An inactive lane (all-null table, pos=0, n_feed=0) rides the
+        dispatch like the oracle's masked lanes: finite output, and the
+        live lanes around it are untouched by its presence."""
+        ps, mp, c = 4, 4, 2
+        pos = np.array([0, 6], np.int32)
+        q, kp, vp, table, posj = _paged_state(2, c, 2, 8, ps, mp, pos,
+                                              seed=3)
+        table = table.at[0].set(0)              # lane 0: nothing live
+        nf = jnp.asarray([0, 2], jnp.int32)
+        got = paged_flash_attention(q, kp, vp, table, posj, nf)
+        want = _gather_oracle(q, kp, vp, table, posj)
+        assert np.isfinite(np.asarray(got)).all()
+        # lane 0 column 0 is what paged_decode_step would read
+        # (max(n_feed-1, 0) = 0) — it must match the oracle too
+        np.testing.assert_allclose(np.asarray(got)[0, 0],
+                                   np.asarray(want)[0, 0], atol=1e-5)
+        _assert_fed_columns_match(got, want, nf)
+
+    def test_property_random_shapes(self):
+        """Property-style sweep: random (ps, mp, B, C, H, K, pos,
+        n_feed) draws — the kernel tracks the oracle at every fed
+        column on every draw."""
+        rng = np.random.default_rng(7)
+        for case in range(8):
+            ps = int(rng.choice([2, 4, 8]))
+            mp = int(rng.integers(2, 7))
+            b = int(rng.integers(1, 4))
+            c = int(rng.integers(1, 5))
+            h = int(rng.choice([1, 2]))
+            kd = int(rng.choice([4, 8]))
+            hi = max(1, ps * mp - c)
+            pos = rng.integers(0, hi, (b,)).astype(np.int32)
+            q, kp, vp, table, posj = _paged_state(
+                b, c, h, kd, ps, mp, pos, seed=100 + case)
+            nf = jnp.asarray(rng.integers(0, c + 1, (b,)), jnp.int32)
+            got = paged_flash_attention(q, kp, vp, table, posj, nf)
+            want = _gather_oracle(q, kp, vp, table, posj)
+            _assert_fed_columns_match(got, want, nf)
+
+    def test_bf16_pool(self):
+        """bf16 pool + queries (the TPU serving dtype): kernel output
+        is bf16 and tracks the f32 oracle to bf16 resolution."""
+        ps, mp, c = 4, 4, 2
+        pos = np.array([5, 9], np.int32)
+        q, kp, vp, table, posj = _paged_state(2, c, 2, 8, ps, mp, pos,
+                                              seed=4)
+        nf = jnp.full((2,), c, jnp.int32)
+        got = paged_flash_attention(q.astype(jnp.bfloat16),
+                                    kp.astype(jnp.bfloat16),
+                                    vp.astype(jnp.bfloat16),
+                                    table, posj, nf)
+        assert got.dtype == jnp.bfloat16
+        want = _gather_oracle(q, kp, vp, table, posj)
+        _assert_fed_columns_match(got.astype(jnp.float32), want, nf,
+                                  atol=2e-2)
+
+
+@pytest.mark.paged_kernel
+class TestPagedKernelFullStack:
+    """Parity through the REAL transformer stack: `paged_forward` and
+    `spec_verify_step` with paged_kernel on vs. off — the exact
+    programs `make_paged_step`/`make_spec_step` jit."""
+
+    def _cfg(self, max_len=32):
+        from deeplearning4j_tpu.parallel import transformer as tfm
+
+        cfg = tfm.TransformerConfig(vocab_size=50, d_model=16,
+                                    n_heads=2, n_layers=2, d_ff=32,
+                                    max_len=max_len)
+        return cfg, tfm.init_params(cfg, jax.random.PRNGKey(0))
+
+    def _state(self, cfg, b, ps, seed=0):
+        from deeplearning4j_tpu.parallel.generation import pages_per_seq
+
+        mp = pages_per_seq(cfg, ps)
+        pages = 1 + b * mp
+        cache = init_paged_cache(cfg, pages, ps)
+        rng = np.random.default_rng(seed)
+        cache = {
+            "k": jnp.asarray(rng.standard_normal(cache["k"].shape),
+                             cache["k"].dtype),
+            "v": jnp.asarray(rng.standard_normal(cache["v"].shape),
+                             cache["v"].dtype)}
+        table = np.zeros((b, mp), np.int32)
+        for i in range(b):
+            table[i] = 1 + i * mp + np.arange(mp)
+        return cache, jnp.asarray(table), mp
+
+    def test_paged_forward_decode_and_chunk(self):
+        cfg, params = self._cfg()
+        for c, pos, nf, seed in [
+            (1, [0, 7, 13], [1, 1, 1], 0),        # decode dispatch
+            (4, [0, 6, 11], [4, 3, 2], 1),        # chunked prefill
+        ]:
+            b = len(pos)
+            cache, table, _ = self._state(cfg, b, ps=4, seed=seed)
+            pos = jnp.asarray(pos, jnp.int32)
+            nf = jnp.asarray(nf, jnp.int32)
+            toks = jnp.asarray(
+                np.random.default_rng(seed).integers(
+                    0, cfg.vocab_size, (b, c)), jnp.int32)
+            lo, co = paged_forward(cfg, params, dict(cache), table, pos,
+                                   nf, toks, paged_kernel=False)
+            lk, ck = paged_forward(cfg, params, dict(cache), table, pos,
+                                   nf, toks, paged_kernel=True)
+            _assert_fed_columns_match(lk, lo, np.asarray(nf), atol=1e-5)
+            # the scatter code is shared; deeper layers' writes inherit
+            # the previous layer's rounding, so tolerance not equality
+            np.testing.assert_allclose(np.asarray(ck["k"]),
+                                       np.asarray(co["k"]), atol=1e-5)
+            np.testing.assert_allclose(np.asarray(ck["v"]),
+                                       np.asarray(co["v"]), atol=1e-5)
+
+    def test_spec_verify_parity(self):
+        """The speculative verify dispatch: bonus logits AND per-lane
+        accepted counts agree between kernel and oracle."""
+        cfg, params = self._cfg()
+        b, w = 3, 4
+        cache, table, _ = self._state(cfg, b, ps=4, seed=5)
+        pos = jnp.asarray([3, 9, 0], jnp.int32)
+        nf = jnp.asarray([4, 3, 1], jnp.int32)     # verify, verify, decode
+        nd = jnp.asarray([3, 2, 0], jnp.int32)
+        toks = jnp.asarray(np.random.default_rng(5).integers(
+            0, cfg.vocab_size, (b, w)), jnp.int32)
+        bo, ao, _ = spec_verify_step(cfg, params, dict(cache), table,
+                                     pos, nf, nd, toks,
+                                     paged_kernel=False)
+        bk, ak, _ = spec_verify_step(cfg, params, dict(cache), table,
+                                     pos, nf, nd, toks,
+                                     paged_kernel=True)
+        np.testing.assert_allclose(np.asarray(bk), np.asarray(bo),
+                                   atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(ak), np.asarray(ao))
+
+    def test_layer_level_paged_attn_switch(self):
+        """`_paged_attn` itself: both switch positions share one
+        scatter and agree at fed columns (C=1 and C=3)."""
+        cfg, params = self._cfg()
+        layer = params["layers"][0]["attn"]
+        for c, seed in [(1, 0), (3, 1)]:
+            b, ps, mp, h, kd = 2, 4, 8, cfg.n_heads, cfg.head_dim
+            rng = np.random.default_rng(seed)
+            x = jnp.asarray(rng.standard_normal((b, c, cfg.d_model)),
+                            jnp.float32)
+            _, kp, vp, table, pos = _paged_state(
+                b, c, h, kd, ps, mp, np.array([5, 2], np.int32),
+                seed=seed)
+            nf = jnp.full((b,), c, jnp.int32)
+            oo, ko, vo = _paged_attn(layer, x, kp, vp, table, pos, nf,
+                                     paged_kernel=False)
+            ok, kk, vk = _paged_attn(layer, x, kp, vp, table, pos, nf,
+                                     paged_kernel=True)
+            np.testing.assert_allclose(np.asarray(ok), np.asarray(oo),
+                                       atol=1e-5)
+            np.testing.assert_array_equal(np.asarray(kk), np.asarray(ko))
+            np.testing.assert_array_equal(np.asarray(vk), np.asarray(vo))
+
+
+@pytest.mark.paged_kernel
+class TestMaskValueAndPolicy:
+    """The dtype-aware mask constant (satellite: the hardcoded -1e30
+    overflowed fp16 to -inf and NaN-poisoned fully masked rows) and the
+    paged_kernel switch-resolution policy."""
+
+    def test_mask_value_finite_in_every_float_dtype(self):
+        for dt in (jnp.float32, jnp.bfloat16, jnp.float16):
+            mv = mask_value(dt)
+            assert mv.dtype == jnp.dtype(dt)
+            assert np.isfinite(np.asarray(mv, np.float32))
+        # the old constant is exactly the fp16 failure being fixed
+        assert np.isinf(np.float16(-1e30))
+
+    def test_fp16_fully_masked_row_stays_finite(self):
+        s = jnp.zeros((2, 4), jnp.float16)
+        masked = jnp.where(jnp.zeros((2, 4), bool), s,
+                           mask_value(s.dtype))
+        w = jax.nn.softmax(masked, axis=-1)
+        assert np.isfinite(np.asarray(w, np.float32)).all()
+        # the -1e30 path NaNs: softmax over a row of -inf
+        bad = jnp.where(jnp.zeros((2, 4), bool), s, jnp.float16(-1e30))
+        assert np.isnan(np.asarray(
+            jax.nn.softmax(bad, axis=-1), np.float32)).all()
+
+    def test_slot_attn_fp16_produces_finite_output(self):
+        """`_slot_attn` end-to-end in fp16 — the cache dtype the mask
+        constant used to poison."""
+        from deeplearning4j_tpu.parallel import transformer as tfm
+        from deeplearning4j_tpu.parallel.generation import _slot_attn
+
+        cfg = tfm.TransformerConfig(vocab_size=20, d_model=8, n_heads=2,
+                                    n_layers=1, d_ff=16, max_len=8,
+                                    dtype="float16")
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        p = params["layers"][0]["attn"]
+        b = 2
+        x = jnp.ones((b, 1, cfg.d_model), jnp.float16)
+        lk = jnp.zeros((b, cfg.max_len, cfg.n_heads, cfg.head_dim),
+                       jnp.float16)
+        lv = jnp.zeros_like(lk)
+        o, _, _ = _slot_attn(p, x, lk, lv, jnp.zeros((b,), jnp.int32))
+        assert np.isfinite(np.asarray(o, np.float32)).all()
+
+    def test_resolve_paged_kernel(self, monkeypatch):
+        assert resolve_paged_kernel(True) is True
+        assert resolve_paged_kernel(False) is False
+        monkeypatch.setenv("DL4J_TPU_PAGED_KERNEL", "1")
+        assert resolve_paged_kernel(None) is True
+        monkeypatch.setenv("DL4J_TPU_PAGED_KERNEL", "0")
+        assert resolve_paged_kernel(None) is False
+        monkeypatch.delenv("DL4J_TPU_PAGED_KERNEL")
+        # unset: kernel iff the backend is a real TPU
+        want = jax.default_backend() == "tpu"
+        assert resolve_paged_kernel(None) is want
+
+    def test_hbm_bytes_model(self):
+        """The bench's cost model: kernel bytes == (live/MP) x gather
+        bytes, exactly — the acceptance inequality by construction."""
+        g = paged_hbm_bytes(2, 8, live_pages=3, max_pages=12,
+                            page_size=16, n_heads=4, head_dim=32,
+                            itemsize=4, kernel=False)
+        k = paged_hbm_bytes(2, 8, live_pages=3, max_pages=12,
+                            page_size=16, n_heads=4, head_dim=32,
+                            itemsize=4, kernel=True)
+        assert k * 12 == g * 3
+        assert k <= g * 3 / 12 + 1

@@ -20,12 +20,14 @@ from tools.dl4jlint import engine  # noqa: E402
 from tools.dl4jlint.pass_excepts import BroadExceptPass  # noqa: E402
 from tools.dl4jlint.pass_jit import JitPurityPass  # noqa: E402
 from tools.dl4jlint.pass_locks import LockDisciplinePass  # noqa: E402
+from tools.dl4jlint.pass_pagedgather import PagedGatherPass  # noqa: E402
 from tools.dl4jlint.pass_recompile import RecompileHazardPass  # noqa: E402
 
 pytestmark = pytest.mark.lint
 
 ALL_PASSES = [LockDisciplinePass(), JitPurityPass(),
-              RecompileHazardPass(), BroadExceptPass()]
+              RecompileHazardPass(), PagedGatherPass(),
+              BroadExceptPass()]
 
 
 def _tree(tmp_path, files):
@@ -460,6 +462,83 @@ def test_locks_detects_annassign_lock_declarations(tmp_path):
     root = _tree(tmp_path, {"deeplearning4j_tpu/serving/ledger.py": src})
     found = _run(root, select=["locks"])
     assert [f.scope for f in found] == ["Ledger.peek"]
+
+
+# ---- pass_pagedgather: page-pool history gathers on decode paths ---------
+
+PAGED_BAD = """
+    import jax.numpy as jnp
+
+    def _paged_attn(q, layer_k, table, ps):
+        gidx = (table[:, :, None] * ps
+                + jnp.arange(ps)[None, None, :]).reshape(2, -1)
+        fk = layer_k.reshape(-1, 2, 8)
+        hk = fk[gidx]                       # full-history gather
+        hv = jnp.take_along_axis(layer_k, gidx[..., None], axis=1)
+        return hk, hv
+"""
+
+PAGED_GOOD = """
+    import jax.numpy as jnp
+
+    def _paged_attn(q, layer_k, table, pos, n_feed, idx, k, b, c):
+        # the scatter half (O(fed columns)) and plain slices are fine
+        fk = layer_k.reshape(-1, 2, 8).at[idx].set(k.reshape(b * c, 2, 8))
+        first = layer_k[0]
+        page = jnp.take_along_axis(table, pos[:, None], axis=1)
+        return fk, first, page
+
+    def export_gather(cache_k, table_row):
+        # shipping path: not a decode-path function name
+        return cache_k[:, table_row]
+"""
+
+
+def test_pagedgather_flags_history_gathers_on_decode_path(tmp_path):
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/parallel/generation.py": PAGED_BAD})
+    found = _run(root, select=["pagedgather"])
+    assert _codes(found) == ["PGD301"]
+    assert sorted(f.symbol for f in found) == ["fk", "layer_k"]
+
+
+def test_pagedgather_accepts_scatter_slices_and_offpath(tmp_path):
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/parallel/generation.py": PAGED_GOOD})
+    assert _run(root, select=["pagedgather"]) == []
+
+
+def test_pagedgather_scope_is_decode_modules_only(tmp_path):
+    # the same gather in nn/ (training math, no block tables) is out of
+    # the pass's scope
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/nn/layers/core.py": PAGED_BAD})
+    assert _run(root, select=["pagedgather"]) == []
+
+
+def test_pagedgather_pragma_suppresses(tmp_path):
+    src = PAGED_BAD.replace(
+        "hk = fk[gidx]                       # full-history gather",
+        "hk = fk[gidx]  # noqa: PGD301 — parity oracle")
+    root = _tree(tmp_path, {
+        "deeplearning4j_tpu/parallel/generation.py": src})
+    found = _run(root, select=["pagedgather"])
+    assert [f.symbol for f in found] == ["layer_k"]  # only the take
+
+
+def test_pagedgather_real_tree_oracle_is_baselined():
+    # the ONE remaining gather — `_paged_attn`'s parity oracle — is
+    # frozen; the kernel plane must not regrow un-frozen gathers
+    found = _run(REPO, select=["pagedgather"])
+    keys = sorted(f.key for f in found)
+    assert keys == [
+        "deeplearning4j_tpu/parallel/generation.py::PGD301::"
+        "_paged_attn::fk",
+        "deeplearning4j_tpu/parallel/generation.py::PGD301::"
+        "_paged_attn::fv"]
+    new = engine.new_findings(found, engine.load_baseline(
+        engine.BASELINE_PATH))
+    assert new == []
 
 
 # ---- pass_excepts: broad handlers through the framework ------------------

@@ -690,3 +690,82 @@ class TestFleetPrefixStats:
         # the storm on one replica, so at least 4 of 6 hit
         assert prefix["hit_rate"] > 0.5
         assert prefix["tokens_saved"] >= 4 * len(system)
+
+
+# ---------------------------------------------------------------------------
+# Paged-attention kernel serving integration (ISSUE-18): the fused
+# block-table kernel rides the SAME compile ladder as the gather oracle
+# — same program count, zero off-ladder compiles — and stays
+# byte-identical to whole-sequence generate().
+
+
+@pytest.mark.paged_kernel
+class TestPagedKernelServing:
+    def test_kernel_pool_greedy_parity_with_generate(self):
+        """Greedy byte-parity of the kernel-backed pool against
+        `generate()` across ragged prompt lengths — including prompts
+        that straddle page boundaries mid-prefill."""
+        cfg, params = _lm(max_len=32)
+        srv = ContinuousLMServer(cfg, params, slots=3, kv="paged",
+                                 page_size=8, prefill_chunk=4,
+                                 paged_kernel=True)
+        try:
+            for plen in (1, 3, 7, 9, 13):
+                prompt = [(5 * i + 1) % 49 + 1 for i in range(plen)]
+                assert srv.generate(prompt, 6, timeout=300) == \
+                    _want(cfg, params, prompt, 6)
+        finally:
+            srv.stop()
+
+    def test_kernel_ladder_zero_new_compiles(self):
+        """The paged_kernel switch changes WHAT each ladder program
+        computes, never how many there are: warmup still compiles the
+        same 3 programs (decode + chunk + CoW) and a mixed-length
+        storm after warmup triggers ZERO XLA compiles — the
+        test_zero.py-style recompile guard for the kernel plane."""
+        import jax.monitoring
+
+        cfg, params = _lm(max_len=32)
+        srv = ContinuousLMServer(cfg, params, slots=2, kv="paged",
+                                 page_size=8, prefill_chunk=4,
+                                 paged_kernel=True)
+        assert srv.warmup() == 3                   # the existing ladder
+        compiles = []
+
+        def listener(event, duration, **kw):
+            if event == "/jax/core/compile/backend_compile_duration":
+                compiles.append(event)
+
+        jax.monitoring.register_event_duration_secs_listener(listener)
+        try:
+            for i, plen in enumerate((2, 5, 9, 1, 12)):
+                prompt = [(3 * (i + j)) % 49 + 1 for j in range(plen)]
+                srv.generate(prompt, 4, timeout=300)
+            stats = srv.stats()
+        finally:
+            jax.monitoring.clear_event_listeners()
+            srv.stop()
+        assert compiles == []
+        assert stats["compiled_programs"] == 3
+        assert stats["kv"]["paged_kernel"] is True
+
+    def test_kernel_speculative_parity(self):
+        """The verify dispatch on the kernel path: speculative greedy
+        output stays byte-identical to 1-token decode."""
+        cfg, params = _lm(max_len=48)
+        prompt = [1, 2, 3, 1, 2, 3, 1]
+        srv = ContinuousLMServer(cfg, params, slots=2, kv="paged",
+                                 page_size=8, prefill_chunk=4,
+                                 speculate="ngram", draft_len=3,
+                                 paged_kernel=True)
+        try:
+            assert srv.generate(prompt, 10, timeout=300) == \
+                _want(cfg, params, prompt, 10)
+        finally:
+            srv.stop()
+
+    def test_kernel_requires_paged_pool(self):
+        cfg, params = _lm()
+        with pytest.raises(ValueError, match="paged_kernel"):
+            ContinuousLMServer(cfg, params, kv="dense",
+                               paged_kernel=True)

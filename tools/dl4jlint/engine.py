@@ -119,10 +119,12 @@ class LintPass:
 def default_passes() -> List[LintPass]:
     # imported lazily so `from tools.dl4jlint.engine import Finding`
     # never drags every pass (and their module-level tables) in
-    from . import pass_excepts, pass_jit, pass_locks, pass_recompile
+    from . import (pass_excepts, pass_jit, pass_locks, pass_pagedgather,
+                   pass_recompile)
     return [pass_locks.LockDisciplinePass(),
             pass_jit.JitPurityPass(),
             pass_recompile.RecompileHazardPass(),
+            pass_pagedgather.PagedGatherPass(),
             pass_excepts.BroadExceptPass()]
 
 

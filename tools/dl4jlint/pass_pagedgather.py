@@ -1,0 +1,142 @@
+"""PGD3xx — paged-pool gather pass.
+
+ISSUE-18 replaced the decode path's full-history gather (`fk[gidx]`:
+materialize every lane's ``MP*ps``-row logical history before softmax)
+with a Pallas kernel that walks the block table in VMEM — the single
+biggest per-token HBM saving in the serving plane.  That win is easy to
+lose silently: one convenient ``take_along_axis`` or fancy-index gather
+of the page pool on a decode-path function and the bandwidth tax is
+back, with no test failing (the gather is numerically correct — it is
+only *slow*).
+
+This pass makes the tax visible at review time.  Inside DECODE-PATH
+functions (name matching attention/decode/prefill/forward/verify/step,
+in ``parallel/`` or ``serving/`` — the modules that dispatch per token)
+it flags:
+
+- PGD301  a fancy-index gather ``pool[idx]`` of a page-pool buffer
+  (names like ``fk``/``fv``/``layer_k``/``cache_v``/``k_pages``…)
+  where the subscript is a computed index array, i.e. an advanced-
+  indexing gather rather than a slice; and
+  ``jnp.take_along_axis(pool, ...)`` / ``jnp.take(pool, ...)`` on the
+  same buffers.
+
+Plain slices (``pool[0]``, ``pool[:, 3]``, ``pool[i, :need]``) are
+structural access, not history gathers, and are not flagged.  The ONE
+legitimate remaining gather — the parity oracle in
+``generation._paged_attn`` — is frozen in the baseline; anything new
+must either ride the kernel or carry a ``# noqa: PGD301 — reason``
+pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from .engine import FileContext, Finding, LintPass
+
+# buffer names that hold (a view of) the KV page pool in this codebase
+_POOL_NAME = re.compile(
+    r"^(fk|fv|hk|hv|layer_k|layer_v|cache_k|cache_v|pool_k|pool_v|"
+    r"k_pages|v_pages|pages_k|pages_v)\d*$")
+
+# functions that sit on the per-token dispatch path
+_DECODE_FN = re.compile(
+    r"(attn|attention|decode|prefill|forward|verify|step)", re.IGNORECASE)
+
+# only the device-dispatch homes; tools/tests/nn math are out of scope
+_SCOPE_PREFIXES = ("deeplearning4j_tpu/parallel/",
+                   "deeplearning4j_tpu/serving/")
+
+_GATHER_CALLS = {"take_along_axis", "take"}
+
+
+def _pool_name(node: ast.AST) -> Optional[str]:
+    """The pool-ish identifier behind `node`, unwrapping the reshape /
+    astype / .at chains the scatter path builds (``fk.reshape(...)`` is
+    still the pool)."""
+    while True:
+        if isinstance(node, ast.Name):
+            return node.id if _POOL_NAME.match(node.id) else None
+        if isinstance(node, ast.Attribute):
+            node = node.value
+            continue
+        if isinstance(node, ast.Call):
+            node = node.func
+            continue
+        return None
+
+
+def _is_computed_index(idx: ast.AST) -> bool:
+    """True for advanced-indexing gathers: the subscript is (or
+    contains) a computed index ARRAY — a bare name (``fk[gidx]``), a
+    call, or arithmetic — rather than constants/slices, which address
+    structure, not history."""
+    if isinstance(idx, ast.Tuple):
+        return any(_is_computed_index(e) for e in idx.elts)
+    if isinstance(idx, (ast.Slice, ast.Constant)):
+        return False
+    if isinstance(idx, ast.UnaryOp) and isinstance(
+            idx.operand, ast.Constant):
+        return False                       # pool[-1]
+    return True
+
+
+class PagedGatherPass(LintPass):
+    name = "pagedgather"
+    description = ("flag full-history page-pool gathers on decode "
+                   "paths (the HBM tax the paged kernel removed)")
+    codes = {
+        "PGD301": "page-pool history gather on a decode path — walk "
+                  "the block table in the kernel instead",
+    }
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.rel.startswith(_SCOPE_PREFIXES):
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if not _DECODE_FN.search(fn.name):
+                continue
+            yield from self._scan_fn(ctx, fn)
+
+    def _scan_fn(self, ctx: FileContext, fn) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Subscript):
+                if isinstance(node.value, ast.Attribute) and \
+                        node.value.attr == "at":
+                    # `pool.at[idx].set(...)` is the SCATTER — O(fed
+                    # columns) traffic, the write half the kernel
+                    # shares — not a history gather
+                    continue
+                name = _pool_name(node.value)
+                if name and _is_computed_index(node.slice):
+                    yield Finding(
+                        path=ctx.rel, line=node.lineno,
+                        col=node.col_offset, code="PGD301",
+                        scope=fn.name, symbol=name,
+                        message=f"fancy-index gather of page pool "
+                                f"`{name}` in decode-path "
+                                f"`{fn.name}` re-materializes the "
+                                f"full history — use "
+                                f"paged_flash_attention")
+            elif isinstance(node, ast.Call):
+                f = node.func
+                attr = (f.attr if isinstance(f, ast.Attribute)
+                        else f.id if isinstance(f, ast.Name) else None)
+                if attr not in _GATHER_CALLS or not node.args:
+                    continue
+                name = _pool_name(node.args[0])
+                if name:
+                    yield Finding(
+                        path=ctx.rel, line=node.lineno,
+                        col=node.col_offset, code="PGD301",
+                        scope=fn.name, symbol=name,
+                        message=f"{attr}() gather of page pool "
+                                f"`{name}` in decode-path "
+                                f"`{fn.name}` — use "
+                                f"paged_flash_attention")

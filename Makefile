@@ -7,7 +7,8 @@
 	obs-smoke evidence lint test-lint test-elastic bench-elastic \
 	test-spec bench-spec test-disagg bench-disagg test-pressure \
 	bench-pressure test-tenancy bench-tenants test-zero bench-zero \
-	test-paged-kernel bench-paged-kernel
+	test-paged-kernel bench-paged-kernel test-hibernate \
+	bench-hibernate
 
 # lint first: the four-pass static sweep is ~1s and fails fast on a
 # race/host-sync/recompile-hazard/broad-except finding before the
@@ -104,6 +105,20 @@ test-pressure:
 # burn-rate victim selection, fleet ledger reconciliation).
 test-tenancy:
 	python -m pytest tests/ -q -m tenancy
+
+# Tiered KV state hierarchy tests only (host/disk store economy,
+# quantized frames at rest, hibernate -> resume byte parity incl. a
+# full process restart over the same disk dir, the disk chaos ladder;
+# docs/robustness.md "The state hierarchy").
+test-hibernate:
+	python -m pytest tests/ -q -m hibernate
+
+# Hibernation bench row: N idle sessions hibernated int8 to the disk
+# tier under a deliberately tight host cap, then resumed COLD — gates
+# at-rest bytes <= 0.3x exact, zero failed resumes, byte parity,
+# balanced ledger, zero off-ladder compiles.
+bench-hibernate:
+	BENCH_ONLY=hibernate python bench.py
 
 # Multi-tenant isolation bench row: tenant-B best_effort flood at 5x
 # its token quota vs tenant-A's interactive wave on the same pool.

@@ -522,6 +522,15 @@ def cmd_serve(args) -> int:
             raise SystemExit(
                 "serve: -lm-preempt/-lm-brownout require -lm-kv paged "
                 "(the overload-survival plane swaps block-table pages)")
+        if args.lm_hibernate_idle_s is not None and args.lm_kv != "paged":
+            raise SystemExit(
+                "serve: -lm-hibernate-idle-s requires -lm-kv paged "
+                "(hibernation parks block-table pages)")
+        if (args.lm_disk_dir is not None and args.lm_hibernate_idle_s
+                is None and not args.lm_preempt):
+            raise SystemExit(
+                "serve: -lm-disk-dir needs -lm-hibernate-idle-s or "
+                "-lm-preempt (nothing would ever reach the disk tier)")
         cfg, params = _load_saved_lm(pathlib.Path(args.lm))
         srv.serve_lm(cfg, params, slots=args.lm_slots,
                      max_queue_depth=max_queue,
@@ -535,7 +544,11 @@ def cmd_serve(args) -> int:
                      ship=args.lm_ship,
                      preempt=args.lm_preempt,
                      swap_bytes=int(args.lm_swap_mb * (1 << 20)),
-                     brownout=args.lm_brownout, tenants=tenants)
+                     brownout=args.lm_brownout, tenants=tenants,
+                     hibernate_idle_s=args.lm_hibernate_idle_s,
+                     state_dir=args.lm_disk_dir,
+                     state_disk_bytes=int(args.lm_disk_mb * (1 << 20)),
+                     swap_quantize=args.lm_swap_quantize == "on")
         lm_srv = srv.state.lm_server
         # -warmup opts the LM pool into pre-traffic compiles too, same
         # contract as the classifier path: without it each program
@@ -554,6 +567,14 @@ def cmd_serve(args) -> int:
                               f"{args.lm_swap_mb:g} MiB)")
             if args.lm_brownout:
                 spec_note += ", brownout ladder on"
+            if lm_srv.hibernate:
+                disk = (f", disk {args.lm_disk_dir}"
+                        f" ({args.lm_disk_mb:g} MiB)"
+                        if args.lm_disk_dir else "")
+                spec_note += (f", hibernation on (idle "
+                              f"{args.lm_hibernate_idle_s:g}s, "
+                              f"{'int8' if lm_srv.swap_quantize else 'exact'}"
+                              f" at rest{disk})")
             print(f"serve: LM registered ({cfg.n_layers}L/d{cfg.d_model}, "
                   f"max_len {cfg.max_len}, {args.lm_slots} decode slots, "
                   f"paged KV: {lm_srv.kv_pages} pages x "
@@ -1447,6 +1468,35 @@ def build_parser() -> argparse.ArgumentParser:
                               "pressure degrade speculation, prefill "
                               "width, then best_effort lanes before "
                               "shedding anything (paged KV only)")
+    p_serve.add_argument("-lm-hibernate-idle-s", "--lm-hibernate-idle-s",
+                         dest="lm_hibernate_idle_s", type=float,
+                         default=None,
+                         help="hibernate a sticky session's KV pages to "
+                              "the tiered state store after this many "
+                              "idle seconds; the next request on the "
+                              "same prefix resumes byte-identically "
+                              "(paged KV only; docs/robustness.md "
+                              "\"The state hierarchy\")")
+    p_serve.add_argument("-lm-disk-dir", "--lm-disk-dir",
+                         dest="lm_disk_dir", default=None,
+                         help="disk tier directory for the tiered state "
+                              "store: host-tier overflow spills to "
+                              "checksummed blob files here, and a "
+                              "restarted server over the same dir "
+                              "resumes hibernated sessions (needs "
+                              "-lm-hibernate-idle-s or -lm-preempt)")
+    p_serve.add_argument("-lm-disk-mb", "--lm-disk-mb",
+                         dest="lm_disk_mb", type=float, default=1024.0,
+                         help="disk tier byte cap in MiB (LRU past it; "
+                              "an evicted session recomputes from its "
+                              "prompt, still byte-identical)")
+    p_serve.add_argument("-lm-swap-quantize", "--lm-swap-quantize",
+                         dest="lm_swap_quantize",
+                         choices=("on", "off"), default="on",
+                         help="per-page int8 quantization for "
+                              "swapped-out and hibernated KV frames "
+                              "(~4x smaller in transit and at rest); "
+                              "'off' keeps exact bytes")
     p_serve.add_argument("-tenants", "--tenants", default=None,
                          help="multi-tenant traffic shaping (JSON): an "
                               "object mapping tenant name -> spec, e.g. "

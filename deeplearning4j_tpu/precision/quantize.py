@@ -77,6 +77,56 @@ def int8_dense(x, q, scale, b, compute_dtype):
     return z * scale.astype(ct) + b.astype(ct)
 
 
+def quantize_kv_pages(pages: np.ndarray, valid: Optional[int] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-page symmetric int8 quantization of a KV page stack
+    `[L, P, ps, H, K]` with one scale per (layer, page, head).
+
+    Per-(L, P, H) granularity keeps the roundtrip error per element
+    below `amax/254` of that head's own dynamic range inside the page —
+    fine enough that greedy/seeded decode over dequantized prefix pages
+    stays token-identical at serving scale — while the scale tensor adds
+    only `4 / (ps * K)` bytes per payload byte (~3% at ps=16, K=8).
+
+    `valid` is the number of leading POSITIONS (across the whole stack,
+    page-major) that hold real KV; rows at or past it are zeroed before
+    the scale is computed so stale device garbage in a partially-filled
+    tail page cannot inflate `amax` and crush the precision of the live
+    rows sharing its scale.  Those rows are masked/rewritten by the
+    decode path anyway, so zeroing them is observationally free.
+
+    Returns `(q int8 [L, P, ps, H, K], scale float32 [L, P, H])` with
+    dequant = q * scale (see `dequantize_kv_pages`)."""
+    w = np.asarray(pages, np.float32)
+    if w.ndim != 5:
+        raise ValueError(f"page stack must be [L, P, ps, H, K], "
+                         f"got shape {w.shape}")
+    L, P, ps, H, K = w.shape
+    if valid is not None:
+        pos = np.arange(P * ps).reshape(P, ps)
+        w = np.where((pos < int(valid))[None, :, :, None, None], w, 0.0)
+    amax = np.max(np.abs(w), axis=(2, 4), keepdims=True)  # [L, P, 1, H, 1]
+    scale = (amax / 127.0).astype(np.float32)
+    scale = np.where(scale == 0.0, np.float32(1.0), scale)
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return q, scale.reshape(L, P, H).astype(np.float32)
+
+
+def dequantize_kv_pages(q: np.ndarray, scale: np.ndarray,
+                        dtype=np.float32) -> np.ndarray:
+    """Inverse of `quantize_kv_pages`: int8 pages + per-(L, P, H) scales
+    -> a float page stack in the pool's KV dtype, dequantized on the
+    host so the device install program is byte-for-byte the same one
+    exact-mode shipments use."""
+    q = np.asarray(q)
+    if q.ndim != 5:
+        raise ValueError(f"page stack must be [L, P, ps, H, K], "
+                         f"got shape {q.shape}")
+    L, P, ps, H, K = q.shape
+    s = np.asarray(scale, np.float32).reshape(L, P, 1, H, 1)
+    return (q.astype(np.float32) * s).astype(dtype)
+
+
 def int8_conv(x, q, scale, b, compute_dtype, strides, padding):
     """NHWC conv on int8 HWIO weights cast in-kernel; per-output-channel
     scale applied to the [B, H, W, cout] result."""

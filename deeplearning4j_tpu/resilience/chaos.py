@@ -64,6 +64,7 @@ deterministic and fast via the stdlib stub worker
 from __future__ import annotations
 
 import dataclasses
+import errno
 import os
 import time
 from typing import Optional, Sequence
@@ -517,6 +518,105 @@ def chaos_swap(store, config: SwapChaosConfig) -> _SwapChaos:
     `serving.pressure.SwapStore` (see `SwapChaosConfig`); returns the
     wrapper — ``.uninstall()`` restores the real `put`."""
     return _SwapChaos(store, config)
+
+
+@dataclasses.dataclass(frozen=True)
+class DiskChaosConfig:
+    """Disk-tier blob faults (ISSUE-19), keyed by BLOB write order
+    (0-based; manifest writes are not counted — they ride the same
+    atomic writer but faulting them is the unreadable-manifest case
+    `DiskTier.open` already owns).  Every fault lands on the victim
+    session alone: its resume must surface a typed
+    `PageShipError`/`SwapEvictedError` internally and recompute from
+    the prompt, byte-identical, ledger balanced.
+
+    - ``truncate_writes``: the blob file is cut to ``truncate_keep``
+      bytes (default: half) AFTER staging — the torn/short write the
+      manifest's size+SHA-256 must catch at take.
+    - ``flip_writes``: one mid-payload byte is flipped on its way to
+      disk — at-rest bit rot, caught by the SHA-256 check.
+    - ``unlink_writes``: the blob vanishes right after its durable
+      write (manifest still names it) — the missing-file rung.
+    - ``enospc_writes``: the write raises ENOSPC before any byte lands
+      — the full-disk rung; the tier drops the entry, counted
+      ``write_failed``.
+    - ``kill_writes``: the staging file is written and fsynced, then
+      the writer dies BEFORE the rename — kill -9 in the commit window;
+      the tier sees a failed write now, and the orphaned ``.tmp-``
+      debris is what a successor's `open()` garbage-collects.
+    """
+
+    truncate_writes: Sequence[int] = ()
+    truncate_keep: Optional[int] = None
+    flip_writes: Sequence[int] = ()
+    unlink_writes: Sequence[int] = ()
+    enospc_writes: Sequence[int] = ()
+    kill_writes: Sequence[int] = ()
+
+
+class _DiskChaos:
+    """Installed over a `DiskTier`'s `_write_atomic` (instance
+    attribute shadows the method; accepts a `TieredStateStore` and
+    reaches its `.disk`).  Counter: ``writes`` (blob writes seen)."""
+
+    _MANIFEST = "MANIFEST.json"
+
+    def __init__(self, tier, config: DiskChaosConfig):
+        disk = getattr(tier, "disk", None)
+        self.tier = disk if disk is not None else tier
+        if not hasattr(self.tier, "_write_atomic"):
+            raise TypeError(
+                f"chaos_disk needs a DiskTier (or a TieredStateStore "
+                f"with one), got {type(tier).__name__}")
+        self.config = config
+        self.writes = 0
+        self._orig = self.tier._write_atomic
+        self.tier._write_atomic = self._write
+
+    def uninstall(self) -> None:
+        self.tier._write_atomic = self._orig
+
+    def _write(self, final_path, data: bytes) -> None:
+        name = os.path.basename(str(final_path))
+        if name == self._MANIFEST:
+            self._orig(final_path, data)
+            return
+        i = self.writes
+        self.writes += 1
+        c = self.config
+        if i in c.enospc_writes:
+            raise OSError(errno.ENOSPC,
+                          "No space left on device (chaos)", str(final_path))
+        if i in c.kill_writes:
+            # stage exactly like the real writer, then die in the
+            # commit window: debris on disk, nothing manifested
+            tmp = os.path.join(os.path.dirname(str(final_path)),
+                               ".tmp-" + name)
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            raise OSError(errno.EIO,
+                          "killed between write and rename (chaos)",
+                          str(final_path))
+        if i in c.flip_writes:
+            pos = len(data) // 2
+            data = data[:pos] + bytes([data[pos] ^ 0xFF]) + data[pos + 1:]
+        if i in c.truncate_writes:
+            keep = (len(data) // 2 if c.truncate_keep is None
+                    else int(c.truncate_keep))
+            data = data[:keep]
+        self._orig(final_path, data)
+        if i in c.unlink_writes:
+            os.unlink(final_path)
+
+
+def chaos_disk(tier, config: DiskChaosConfig) -> _DiskChaos:
+    """Install deterministic disk-tier faults on a
+    `serving.hibernate.DiskTier` (or the `TieredStateStore` wrapping
+    one); returns the wrapper — ``.uninstall()`` restores the real
+    atomic writer."""
+    return _DiskChaos(tier, config)
 
 
 @dataclasses.dataclass(frozen=True)

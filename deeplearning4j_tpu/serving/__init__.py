@@ -94,6 +94,20 @@ coalescing K concurrent *requests* per device dispatch.
   an offender exists); per-tenant ledgers that must re-add to the
   plane totals (`check_fleet_ledger` reports drift as a typed
   failure) — docs/robustness.md "Tenancy & SLOs";
+- tiered KV state hierarchy (`hibernate.py`, ISSUE-19): device pages →
+  host LRU tier → disk tier of checksummed, atomically-written blobs
+  behind a `MANIFEST.json`; `TieredStateStore` is the `SwapStore`
+  surface with a durable bottom, so preempted-lane swap state spills
+  to disk instead of vanishing, and idle sticky sessions HIBERNATE
+  (`ContinuousLMServer(hibernate_idle_s=..., state_dir=...)`): their
+  pages leave the device entirely, keyed by a digest of the token
+  prefix (`prefix_key`), and a later request — even from a FRESH
+  process over the same directory — resumes them byte-identically.
+  KV travels and rests per-page int8-quantized by default
+  (`quantize_export`, ~4x smaller; `swap_quantize=False` keeps exact
+  bytes); torn/truncated/corrupt/missing blobs surface as typed
+  errors on the victim alone and the session recomputes from its
+  prompt (docs/robustness.md "The state hierarchy");
 - process supervision (`procfleet.py`, ISSUE-10): `FleetSupervisor`
   owns spawned worker processes end-to-end — exit-status + `/readyz`
   crash detection with clean/crash/wedged classification, exponential
@@ -130,6 +144,11 @@ from deeplearning4j_tpu.serving.fleet import (
     Replica,
     check_fleet_ledger,
     spawn_local_replica,
+)
+from deeplearning4j_tpu.serving.hibernate import (
+    DiskTier,
+    TieredStateStore,
+    prefix_key,
 )
 from deeplearning4j_tpu.serving.lm import ContinuousLMServer
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
@@ -175,6 +194,7 @@ from deeplearning4j_tpu.serving.transfer import (
     PageShipError,
     check_compatible,
     deserialize_export,
+    quantize_export,
     serialize_export,
 )
 
@@ -188,6 +208,7 @@ __all__ = [
     "DEFAULT_BATCH_BUCKETS",
     "DEFAULT_TENANT",
     "DeadlineExceededError",
+    "DiskTier",
     "Drafter",
     "FairQueueClock",
     "FleetClientError",
@@ -220,6 +241,7 @@ __all__ = [
     "TenantQuotaError",
     "TenantRegistry",
     "TenantSpec",
+    "TieredStateStore",
     "TokenBucketMeter",
     "UnservableShapeError",
     "WorkerSpec",
@@ -228,6 +250,8 @@ __all__ = [
     "deserialize_export",
     "normalize_priority",
     "pow2_length_buckets",
+    "prefix_key",
+    "quantize_export",
     "serialize_export",
     "spawn_local_replica",
 ]

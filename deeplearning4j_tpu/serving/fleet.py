@@ -289,6 +289,10 @@ def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
                         lm_swap_bytes: int = 64 << 20,
                         lm_brownout=None,
                         lm_tenants=None,
+                        lm_hibernate_idle_s: Optional[float] = None,
+                        lm_state_dir: Optional[str] = None,
+                        lm_state_disk_bytes: int = 1 << 30,
+                        lm_swap_quantize: bool = True,
                         role: str = ROLE_BOTH,
                         version: int = 0) -> Replica:
     """Thread-hosted replica: an in-process `UiServer` on a free port
@@ -329,7 +333,11 @@ def spawn_local_replica(name: str, net=None, *, lm=None, lm_slots: int = 4,
                      speculate=lm_speculate, draft_len=lm_draft_len,
                      ship=ship, preempt=lm_preempt,
                      swap_bytes=lm_swap_bytes, brownout=lm_brownout,
-                     tenants=lm_tenants)
+                     tenants=lm_tenants,
+                     hibernate_idle_s=lm_hibernate_idle_s,
+                     state_dir=lm_state_dir,
+                     state_disk_bytes=lm_state_disk_bytes,
+                     swap_quantize=lm_swap_quantize)
         # warm the paged programs BEFORE the replica enters rotation —
         # same zero-compile-on-the-request-path rule as warmup_example
         if srv.state.lm_server is not None:

@@ -186,6 +186,10 @@ from deeplearning4j_tpu.obs.trace import (
     span,
     trace,
 )
+from deeplearning4j_tpu.serving.hibernate import (
+    TieredStateStore,
+    prefix_key,
+)
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.paged import PagePool, RadixPrefixCache
 from deeplearning4j_tpu.serving.pressure import (
@@ -194,7 +198,6 @@ from deeplearning4j_tpu.serving.pressure import (
     PressureConfig,
     RANK_BEST_EFFORT,
     SwapEvictedError,
-    SwapStore,
     normalize_priority,
 )
 from deeplearning4j_tpu.serving.resilience import (
@@ -217,6 +220,7 @@ from deeplearning4j_tpu.serving.transfer import (
     check_compatible,
     deserialize_export,
     model_signature,
+    quantize_export,
     serialize_export,
 )
 
@@ -338,6 +342,10 @@ class ContinuousLMServer:
                  preempt: bool = False, swap_bytes: int = 64 << 20,
                  brownout=None, tenants=None,
                  paged_kernel: Optional[bool] = None,
+                 hibernate_idle_s: Optional[float] = None,
+                 state_dir: Optional[str] = None,
+                 state_disk_bytes: int = 1 << 30,
+                 swap_quantize: bool = True,
                  tracer: Optional[TraceRecorder] = None,
                  registry: Optional[MetricsRegistry] = None):
         if slots < 1:
@@ -385,6 +393,22 @@ class ContinuousLMServer:
             raise ValueError(
                 f"paged_kernel=True requires kv='paged' (got kv={kv!r}):"
                 f" the fused kernel walks the block tables")
+        if hibernate_idle_s is not None:
+            if kv != "paged":
+                raise ValueError(
+                    f"hibernate_idle_s requires kv='paged' (got "
+                    f"kv={kv!r}): hibernation parks block-table pages "
+                    f"on the tiered state store")
+            if float(hibernate_idle_s) < 0:
+                raise ValueError(
+                    f"hibernate_idle_s must be >= 0, got "
+                    f"{hibernate_idle_s}")
+        if state_dir is not None and not (preempt
+                                          or hibernate_idle_s is not None):
+            raise ValueError(
+                "state_dir names a disk tier nothing would write: it "
+                "requires preempt=True or hibernate_idle_s (serve with "
+                "-lm-preempt or -lm-hibernate-idle-s)")
         self.cfg = cfg
         self.params = params
         self.n_slots = int(slots)
@@ -460,8 +484,35 @@ class ContinuousLMServer:
         # it is worker-thread state mutated under self._cond (the same
         # single-mutator discipline as the page pool).
         self.preempt = bool(preempt)
-        self._swap = SwapStore(int(swap_bytes)) if self.preempt else None
+        # tiered state hierarchy (ISSUE-19): ONE store serves both the
+        # preemption swap plane (process-local "swap-<n>" keys) and the
+        # hibernation plane (content-addressed "hib-<digest>" keys).
+        # With a state_dir the host LRU tier spills to a checksummed
+        # disk tier, so idle-session capacity is bounded by disk.
+        self.hibernate_idle_s = (float(hibernate_idle_s)
+                                 if hibernate_idle_s is not None else None)
+        self.hibernate = self.hibernate_idle_s is not None
+        self.swap_quantize = bool(swap_quantize)
+        self.state_dir = str(state_dir) if state_dir is not None else None
+        if self.preempt or self.hibernate:
+            self._swap = TieredStateStore(
+                int(swap_bytes), disk_dir=self.state_dir,
+                disk_bytes=int(state_disk_bytes))
+            if self.state_dir is not None:
+                # a crashed predecessor's process-local swap keys can
+                # never restore in THIS process — GC them (counted);
+                # hibernated prefixes are content-addressed and stay
+                # valid across restarts, so they survive untouched
+                self._swap.gc("swap-")
+        else:
+            self._swap = None
         self._swap_seq = 0
+        # idle-session tracking for hibernation: session_id -> the full
+        # committed token sequence + last-activity stamp, LRU-bounded.
+        # Worker-thread state like the slots (finish-fold writes it,
+        # the admit-round sweep drains it).
+        self._hib_sessions: "collections.OrderedDict[str, Dict]" = (
+            collections.OrderedDict())
         if brownout is None or brownout is False:
             self._pressure = None
         elif isinstance(brownout, PressureConfig):
@@ -915,6 +966,11 @@ class ContinuousLMServer:
         sequence, byte-identical to a locally-prefilled lane."""
         self._require_ship("import")
         check_compatible(export, self.cfg, self.page_size)
+        if export.quantized and not self.swap_quantize:
+            raise PageShipError(
+                "shipment is int8-quantized but this pool runs "
+                "swap_quantize=off: refusing a lossy install on an "
+                "exact-bytes pool (recompute locally instead)")
         if len(export.committed) >= export.max_new:
             # the prefill worker's first sample already filled the whole
             # budget (max_new == 1): nothing to decode — answer without
@@ -1046,10 +1102,11 @@ class ContinuousLMServer:
         with compile_scope("lm:page_copy"):
             k, v = self._copy(*self._cache, np.int32(0), np.int32(0))
         self._cache = (k, v)
-        if self.ship or self.preempt:
-            # the shipping/swap pair: a gather out of the live pool (not
-            # donated — the row of nulls reads only the null page) and
-            # an n=0 install whose every row lands on the null page
+        if self.ship or self.preempt or self.hibernate:
+            # the shipping/swap/hibernate pair: a gather out of the live
+            # pool (not donated — the row of nulls reads only the null
+            # page) and an n=0 install whose every row lands on the
+            # null page
             zrow = np.zeros((self.max_pages,), np.int32)
             with compile_scope("lm:page_gather"):
                 self._gather(*self._cache, zrow)
@@ -1064,9 +1121,10 @@ class ContinuousLMServer:
     def compiled_programs(self) -> int:
         if self.kv == "dense":
             return 1
-        # page gather + batched install serve BOTH the shipping wire
-        # plane and preemption swap-out/restore — one compiled pair
-        ship = 2 if (self.ship or self.preempt) else 0
+        # page gather + batched install serve the shipping wire plane,
+        # preemption swap-out/restore AND hibernate/resume — one
+        # compiled pair for all three
+        ship = 2 if (self.ship or self.preempt or self.hibernate) else 0
         if self.speculate != "off":
             # 1-wide decode + the shared prefill/verify wide program +
             # page copy, plus whatever the drafter runs on device
@@ -1192,6 +1250,13 @@ class ContinuousLMServer:
                 if self._pressure is not None:
                     pres["brownout"] = self._pressure.stats()
                 out["pressure"] = pres
+            if self.hibernate and self._swap is not None:
+                out["hibernation"] = {
+                    "idle_s": self.hibernate_idle_s,
+                    "quantize": self.swap_quantize,
+                    "disk": self.state_dir,
+                    "tracked_sessions": len(self._hib_sessions),
+                    "store": self._swap.stats()}
             if self.tenants is not None:
                 out["tenancy"] = self.tenants.stats()
             if self.speculate != "off":
@@ -1272,8 +1337,13 @@ class ContinuousLMServer:
             # paths either fail every request that could restore them
             # (stop) or want one coherent story (failed dispatch):
             # clear, and let any surviving queued victim take the
-            # recompute-from-prompt path — byte-identical either way
-            self._swap.clear()
+            # recompute-from-prompt path — byte-identical either way.
+            # HIBERNATED entries ("hib-") survive the reset: they are
+            # content-addressed by prompt tokens and the KV they carry
+            # is deterministic from those tokens, so they stay valid no
+            # matter what happened to the device pool.
+            self._swap.clear("swap-")
+        self._hib_sessions.clear()
         self.metrics.set_pages(0, self.kv_pages, self.kv_pages)
 
     def _start_locked(self) -> None:
@@ -1310,7 +1380,7 @@ class ContinuousLMServer:
                         if self.prefill_chunk > 1 else None)
                 self._copy = make_page_copy(self.cfg, total,
                                             self.page_size)
-                if self.ship or self.preempt:
+                if self.ship or self.preempt or self.hibernate:
                     from deeplearning4j_tpu.parallel.generation import (
                         make_page_gather,
                         make_page_install,
@@ -1402,6 +1472,11 @@ class ContinuousLMServer:
             ex = deserialize_export(blob)
             check_compatible(ex, self.cfg, self.page_size,
                              mid_decode=True)
+            if ex.quantized and not self.swap_quantize:
+                raise PageShipError(
+                    "swapped frame is int8-quantized but this pool "
+                    "runs swap_quantize=off: refusing a lossy restore "
+                    "on an exact-bytes pool")
         except PageShipError as e:
             self.metrics.record_swap_lost("corrupt")
             req.swap_error = f"{type(e).__name__}: {e}"
@@ -1456,6 +1531,17 @@ class ContinuousLMServer:
         full, partial = self._tree.match(req.prompt[:plen - 1])
         if len(full) > total_pages:     # cannot happen (cap above), but
             raise AssertionError("radix match exceeded the page budget")
+        resume = None
+        if self.hibernate and self._swap is not None:
+            # a hibernated session's prompt prefix may cover MORE pages
+            # than the tree still holds: probe the tiered store for the
+            # longest stored whole-page prefix beyond the radix match
+            resume = self._probe_hibernated_locked(req, len(full))
+        if resume is not None and partial is not None:
+            # the resumed frame extends past the divergence page: the
+            # CoW copy would duplicate content the frame carries exactly
+            self._pool.release([partial[0]])
+            partial = None
         need = total_pages - len(full)
         if self._pool.free < need:
             # evict ONLY when eviction can actually cover the shortfall:
@@ -1471,11 +1557,69 @@ class ContinuousLMServer:
                 self._pool.release(full)
             if partial is not None:
                 self._pool.release([partial[0]])
+            if resume is not None:
+                # un-consume the blob: the session's state must survive
+                # until the pool can actually seat the lane
+                self._swap.put(resume["key"], resume["blob"])
             return None
+        if resume is not None:
+            return {"full": full, "partial": None, "fresh": fresh,
+                    "matched": int(resume["n_hib"]) * self.page_size,
+                    "total_pages": total_pages, "resume": resume}
         matched = len(full) * self.page_size + (partial[1]
                                                 if partial else 0)
         return {"full": full, "partial": partial, "fresh": fresh,
                 "matched": matched, "total_pages": total_pages}
+
+    def _probe_hibernated_locked(self, req: _LMRequest,
+                                 have: int) -> Optional[Dict]:
+        """Longest hibernated whole-page prompt prefix beyond the
+        `have` pages the radix tree already matched -> an exact
+        (dequantized) `PageExport` ready for the pending-install plane,
+        or None.  Probes deepest-first by content digest, so the cost
+        on a miss is one digest per candidate depth, no I/O.  A stored
+        blob that is gone or fails its integrity/geometry/quantization
+        checks is the typed resume-loss path: counted on the hibernate
+        ledger, stamped on THIS request's trace, and the probe keeps
+        descending — shallower prefixes may still be intact."""
+        plen = len(req.prompt)
+        for k in range((plen - 1) // self.page_size, have, -1):
+            covered = [int(t) for t in req.prompt[:k * self.page_size]]
+            key = prefix_key(covered)
+            if key not in self._swap:
+                continue
+            try:
+                blob = self._swap.take(key)
+            except SwapEvictedError as e:
+                self.metrics.record_hibernate_lost("evicted")
+                req.swap_error = f"{type(e).__name__}: {e}"
+                continue
+            except PageShipError as e:
+                self.metrics.record_hibernate_lost("corrupt")
+                req.swap_error = f"{type(e).__name__}: {e}"
+                continue
+            try:
+                ex = deserialize_export(blob)
+                check_compatible(ex, self.cfg, self.page_size,
+                                 prefix=True)
+                if ex.quantized and not self.swap_quantize:
+                    raise PageShipError(
+                        "hibernated frame is int8-quantized but this "
+                        "pool runs swap_quantize=off: refusing a lossy "
+                        "resume on an exact-bytes pool")
+                if ex.prompt != covered:
+                    raise PageShipError(
+                        "hibernated frame's tokens diverge from its "
+                        "digest key: refusing to install foreign KV")
+            except PageShipError as e:
+                self.metrics.record_hibernate_lost("corrupt")
+                req.swap_error = f"{type(e).__name__}: {e}"
+                continue
+            nbytes = ex.nbytes()
+            exact = ex.exact_nbytes()
+            return {"ex": ex.dequantized(), "n_hib": k, "nbytes": nbytes,
+                    "exact_nbytes": exact, "key": key, "blob": blob}
+        return None
 
     def _install_paged_locked(self, slot: _Slot, req: _LMRequest,
                               plan) -> None:
@@ -1507,6 +1651,10 @@ class ContinuousLMServer:
             # enter the local radix tree now so the next shared-prefix
             # admission (this session's next turn) reuses them.
             ex = req.import_pages
+            # at-rest/wire bytes BEFORE dequantizing — the ledger must
+            # read what actually moved through the store or the wire
+            wire_nbytes = ex.nbytes()
+            ex = ex.dequantized()   # identity on exact frames
             slot.fed = len(req.prompt)
             slot.pos = int(ex.pos)
             slot.generated = list(ex.committed)
@@ -1527,7 +1675,7 @@ class ContinuousLMServer:
             irow[:len(plan["full"])] = 0
             self._pending_install.append(
                 {"pk": pk, "pv": pv, "row": irow, "n": n_ship,
-                 "nbytes": ex.nbytes(), "swap": req.swap_restore})
+                 "nbytes": wire_nbytes, "swap": req.swap_restore})
             self.metrics.record_prefix_query(plan["matched"])
             n_full_prompt = len(req.prompt) // self.page_size
             if n_full_prompt:
@@ -1546,7 +1694,36 @@ class ContinuousLMServer:
                 self.metrics.record_first_token(
                     req.t_first - req.enqueued)
             return
-        if plan["partial"] is not None:
+        res = plan.get("resume")
+        if res is not None:
+            # hibernated-session resume (ISSUE-19): the store held KV
+            # for a longer prompt prefix than the radix tree — install
+            # the resumed pages through the same pending plane a
+            # shipment uses, then register them in the tree so the
+            # session's NEXT turn (or a concurrent shared-prefix
+            # admission) reuses them without touching disk.  Rows the
+            # tree already served stay zeroed (null page): shared pages
+            # other lanes may be reading are never rewritten.
+            ex = res["ex"]
+            n_hib = int(res["n_hib"])
+            mp = self.max_pages
+            shape = (self.cfg.n_layers, mp, self.page_size,
+                     self.cfg.n_heads, self.cfg.head_dim)
+            pk = np.zeros(shape, np.dtype(self.cfg.dtype))
+            pv = np.zeros(shape, np.dtype(self.cfg.dtype))
+            pk[:, :n_hib] = ex.pages_k
+            pv[:, :n_hib] = ex.pages_v
+            irow = row.copy()
+            irow[:n_full] = 0
+            irow[n_hib:] = 0
+            self._pending_install.append(
+                {"pk": pk, "pv": pv, "row": irow, "n": n_hib,
+                 "nbytes": res["nbytes"],
+                 "exact_nbytes": res["exact_nbytes"],
+                 "pages": n_hib, "hibernate": True})
+            self._tree.insert(req.prompt[:n_hib * self.page_size],
+                              [int(p) for p in row[:n_hib]])
+        elif plan["partial"] is not None:
             # copy-on-write: the divergence page's matched tokens are
             # valid KV; copy it into this lane's first fresh page and
             # overwrite from the divergence offset.  The source stays
@@ -1612,6 +1789,7 @@ class ContinuousLMServer:
             self._queue = kept
             self.metrics.record_shed(shed)
         self._update_pressure_locked()
+        self._hibernate_idle_locked(now)
         for slot in self._slots:
             if not self._queue:
                 break
@@ -1640,6 +1818,77 @@ class ContinuousLMServer:
         if self.kv == "paged" and self._pool is not None:
             self.metrics.set_pages(self._pool.in_use, self._pool.free,
                                    self.kv_pages)
+
+    def _hibernate_idle_locked(self, now: float) -> None:
+        """Park idle sticky sessions' cached pages on the tiered state
+        store (ISSUE-19).  A session is idle once `hibernate_idle_s`
+        has passed since its last completion; its radix-cached chain is
+        gathered in one fixed-shape dispatch, (optionally) quantized,
+        serialized through the integrity-checked wire frame, stored
+        under its content digest, and the tree's hold on the pages is
+        dropped — device capacity frees while the session's KV rests
+        on host or disk, resumable hours later byte-identically (the
+        store outlives pool resets AND, with a state_dir, the
+        process)."""
+        if (not self.hibernate or self._swap is None
+                or self._gather is None or self._cache is None
+                or self._tree is None or not self._hib_sessions):
+            return
+        idle = [sid for sid, meta in self._hib_sessions.items()
+                if now - meta["t"] >= self.hibernate_idle_s]
+        for sid in idle:
+            meta = self._hib_sessions.pop(sid)
+            tokens = meta["tokens"]
+            # only positions BEFORE the final sampled token have KV
+            # (the last sample is returned, never fed) — park exactly
+            # the fully-written pages
+            n_full = (len(tokens) - 1) // self.page_size
+            if n_full == 0:
+                continue
+            covered = [int(t) for t in tokens[:n_full * self.page_size]]
+            full, partial = self._tree.match(covered)
+            if partial is not None:
+                self._pool.release([partial[0]])
+            if len(full) != n_full:
+                # the tree already evicted part of the chain under
+                # pressure: nothing complete to park — whatever prefix
+                # remains keeps serving radix hits
+                if full:
+                    self._pool.release(full)
+                continue
+            row = np.zeros((self.max_pages,), np.int32)
+            row[:n_full] = full
+            with compile_scope("lm:page_gather"):
+                pk, pv = self._gather(*self._cache, row)
+            pk = np.asarray(pk)[:, :n_full]
+            pv = np.asarray(pv)[:, :n_full]
+            ex = PageExport(
+                prompt=covered, max_new=1, temperature=0.0, seed=0,
+                committed=[], pos=n_full * self.page_size,
+                page_size=self.page_size, pages_k=pk, pages_v=pv,
+                model=model_signature(self.cfg, self.page_size),
+                session_id=sid)
+            exact = ex.exact_nbytes()
+            if self.swap_quantize:
+                ex = quantize_export(ex)
+            blob = serialize_export(ex)
+            stored = self._swap.put(prefix_key(covered), blob)
+            self._pool.release(full)
+            if stored is None:
+                # the blob alone exceeds the host cap: nothing was
+                # parked and nothing was lost — the pages stay in the
+                # radix tree and keep serving hits from device
+                continue
+            for lost in stored:
+                # hibernated prefixes pushed off the capped tiers are
+                # counted NOW — a resume probe treats a missing key as
+                # a plain miss, so eviction time is the only chance
+                # (swap-keyed victims stay counted at restore, as ever)
+                if lost.startswith("hib-"):
+                    self.metrics.record_hibernate_lost("evicted")
+            self.metrics.record_hibernate("out", n_full, ex.nbytes(),
+                                          exact)
+            self._tree.forget(covered)
 
     def _drop_swap_locked(self, req: _LMRequest) -> None:
         """A shed/abandoned queue item releases its host swap bytes."""
@@ -1766,6 +2015,12 @@ class ContinuousLMServer:
                 model=model_signature(self.cfg, self.page_size),
                 session_id=req.session_id, priority=req.priority,
                 tenant=req.tenant)
+            if self.swap_quantize:
+                # per-page int8 in transit and at rest (ISSUE-19):
+                # ~4x fewer bytes through the tiers; the deterministic
+                # resume-parity tests pin that dequantized restore
+                # still reproduces the exact token stream
+                ex = quantize_export(ex)
             blob = serialize_export(ex)
             key = f"swap-{self._swap_seq}"
             self._swap_seq += 1
@@ -1823,6 +2078,28 @@ class ContinuousLMServer:
                 self.tenants.slo.record(tn, now - slot.req.enqueued)
                 self.metrics.set_tenant_burn(
                     tn, self.tenants.slo.burn_rate(tn))
+            if (self.hibernate and slot.req.session_id is not None
+                    and self._tree is not None
+                    and slot.table is not None):
+                # sticky-session hibernation tracking (ISSUE-19): the
+                # FULL committed sequence's whole pages enter the radix
+                # tree (prompt pages alone would forget the generated
+                # turn), and the session is stamped for the idle sweep.
+                # Only fully-WRITTEN pages insert — the final sampled
+                # token is returned, never fed, so its position has no
+                # KV yet.
+                seq = slot.req.result
+                n_full = (len(seq) - 1) // self.page_size
+                if n_full:
+                    self._tree.insert(
+                        seq[:n_full * self.page_size],
+                        [int(p) for p in slot.table[:n_full]])
+                sid = slot.req.session_id
+                self._hib_sessions[sid] = {"tokens": list(seq),
+                                           "t": now}
+                self._hib_sessions.move_to_end(sid)
+                while len(self._hib_sessions) > self._session_capacity:
+                    self._hib_sessions.popitem(last=False)
             slot.req.event.set()
         self._free_slot_pages(slot)
         slot.req = None
@@ -2061,6 +2338,13 @@ class ContinuousLMServer:
                 # swap ledger, not the wire-shipping one
                 self.metrics.record_swap("in", item["n"],
                                          item["nbytes"])
+            elif item.get("hibernate"):
+                # a hibernated session resuming from the tiered store —
+                # the hibernation ledger (at-rest vs exact bytes feed
+                # the compression ratio the bench gates on)
+                self.metrics.record_hibernate("in", item["pages"],
+                                              item["nbytes"],
+                                              item["exact_nbytes"])
             else:
                 self.metrics.record_ship("in", item["n"],
                                          item["nbytes"],
@@ -2211,6 +2495,14 @@ class ContinuousLMServer:
                     # page contents survive a stop only as long as the
                     # buffers do — release everything in one sweep
                     self._reset_pool_locked()
+                    if (self.hibernate and self._swap is not None
+                            and self.state_dir is not None):
+                        # a clean stop makes hibernation durable: demote
+                        # host-tier entries (only hib- remain — the
+                        # reset above dropped the swap- lane state) so a
+                        # restarted server over the same state_dir
+                        # resumes them instead of recomputing
+                        self._swap.flush_to_disk()
                     if self._warm_req is not None:
                         # a warmup() waiting on a stopped server must
                         # unblock, not sit out its timeout

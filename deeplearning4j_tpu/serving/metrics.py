@@ -191,6 +191,34 @@ class ServingMetrics:
             "serving_kv_swap_corrupt_total",
             "swapped lanes whose state failed the SHA-256 restore "
             "check (restore recomputes from the prompt)")
+        # tiered-state hibernation ledger (ISSUE-19): idle sticky
+        # sessions parked on the host/disk hierarchy and resumed later,
+        # plus the compression ledger (at-rest vs exact bytes — the
+        # quantized tiers' ~4x claim is verified against these)
+        self.hibernated_total = Counter(
+            "serving_kv_hibernated_total",
+            "idle sessions hibernated to the tiered state store")
+        self.resumed_total = Counter(
+            "serving_kv_resumed_total",
+            "sessions resumed from the tiered state store")
+        self.hibernate_pages_total = Counter(
+            "serving_kv_hibernate_pages_total",
+            "KV pages moved through hibernation (both directions)")
+        self.hibernate_bytes_total = Counter(
+            "serving_kv_hibernate_bytes_total",
+            "at-rest bytes moved through hibernation (quantized when on)")
+        self.hibernate_exact_bytes_total = Counter(
+            "serving_kv_hibernate_exact_bytes_total",
+            "exact-dtype-equivalent bytes of hibernated pages (the "
+            "compression ratio's denominator)")
+        self.hibernate_evicted_total = Counter(
+            "serving_kv_hibernate_evicted_total",
+            "hibernated sessions whose state fell off the byte-capped "
+            "tiers (resume recomputes from the prompt)")
+        self.hibernate_corrupt_total = Counter(
+            "serving_kv_hibernate_corrupt_total",
+            "hibernated sessions whose blob failed its integrity check "
+            "at resume (recompute from the prompt)")
         self.brownout_level_gauge = Gauge(
             "serving_brownout_level",
             "degradation-ladder level (0 healthy .. 4 shedding)")
@@ -253,7 +281,13 @@ class ServingMetrics:
                   self.preemptions_total, self.swap_out_total,
                   self.swap_in_total, self.swap_pages_total,
                   self.swap_bytes_total, self.swap_evicted_total,
-                  self.swap_corrupt_total, self.brownout_level_gauge,
+                  self.swap_corrupt_total,
+                  self.hibernated_total, self.resumed_total,
+                  self.hibernate_pages_total, self.hibernate_bytes_total,
+                  self.hibernate_exact_bytes_total,
+                  self.hibernate_evicted_total,
+                  self.hibernate_corrupt_total,
+                  self.brownout_level_gauge,
                   self.brownout_transitions_total,
                   self.brownout_shed_total,
                   self.latency_hist, self.queue_wait_hist,
@@ -455,6 +489,29 @@ class ServingMetrics:
         (self.swap_corrupt_total if kind == "corrupt"
          else self.swap_evicted_total).inc()
 
+    def record_hibernate(self, direction: str, pages: int, nbytes: int,
+                         exact_nbytes: int) -> None:
+        """One session hibernated 'out' to (or resumed 'in' from) the
+        tiered state store.  `nbytes` is the at-rest frame size
+        (quantized when the knob is on), `exact_nbytes` the same pages
+        at their exact dtype — the pair is the compression ledger the
+        hibernate bench row's <= 0.3x gate reads (ISSUE-19)."""
+        self._touch()
+        (self.hibernated_total if direction == "out"
+         else self.resumed_total).inc()
+        self.hibernate_pages_total.inc(int(pages))
+        self.hibernate_bytes_total.inc(int(nbytes))
+        self.hibernate_exact_bytes_total.inc(int(exact_nbytes))
+
+    def record_hibernate_lost(self, kind: str) -> None:
+        """A hibernated session's state was unusable at resume: `kind`
+        is 'evicted' (fell off a byte-capped tier) or 'corrupt'
+        (checksum/manifest/frame failure).  The session recomputes from
+        its prompt — byte-identical output, ledger-only loss."""
+        self._touch()
+        (self.hibernate_corrupt_total if kind == "corrupt"
+         else self.hibernate_evicted_total).inc()
+
     def record_brownout(self, level: int, transitions: int = 0) -> None:
         """Publish the current ladder level; `transitions` new level
         changes since the last call (counted, per the ISSUE-15
@@ -620,6 +677,23 @@ class ServingMetrics:
                 "bytes": int(self.swap_bytes_total.value),
                 "evicted": int(self.swap_evicted_total.value),
                 "corrupt": int(self.swap_corrupt_total.value)}
+        hib = (int(self.hibernated_total.value)
+               + int(self.resumed_total.value)
+               + int(self.hibernate_evicted_total.value)
+               + int(self.hibernate_corrupt_total.value))
+        if hib:
+            at_rest = int(self.hibernate_bytes_total.value)
+            exact = int(self.hibernate_exact_bytes_total.value)
+            out["hibernate"] = {
+                "out": int(self.hibernated_total.value),
+                "in": int(self.resumed_total.value),
+                "pages": int(self.hibernate_pages_total.value),
+                "bytes": at_rest,
+                "exact_bytes": exact,
+                "bytes_ratio": (round(at_rest / exact, 4) if exact
+                                else 1.0),
+                "evicted": int(self.hibernate_evicted_total.value),
+                "corrupt": int(self.hibernate_corrupt_total.value)}
         if (int(self.brownout_transitions_total.value)
                 or int(self.brownout_level_gauge.value)):
             out["brownout"] = {

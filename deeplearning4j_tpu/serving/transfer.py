@@ -48,9 +48,15 @@ from typing import Dict, List, Optional
 import numpy as np
 
 # frame magic + format version: bump WIRE_VERSION on any header/payload
-# layout change so a mixed-version fleet fails typed, not misparsed
+# layout change so a mixed-version fleet fails typed, not misparsed.
+# v1: raw k‖v page payload.  v2 (ISSUE-19): adds an optional "quant"
+# header section — payload is int8 k‖v followed by the float32
+# per-(layer, page, head) scale stacks.  Exact-mode frames still
+# serialize as v1 byte-for-byte, so a pre-ISSUE-19 reader keeps working
+# until it meets a quantized frame, which it rejects TYPED by version.
 MAGIC = b"DL4JKVS\x01"
-WIRE_VERSION = 1
+WIRE_VERSION = 2
+_KNOWN_VERSIONS = (1, 2)
 
 # header fields every frame must carry (missing = typed, not KeyError)
 _REQUIRED = ("version", "prompt", "max_new", "temperature", "seed",
@@ -101,26 +107,91 @@ class PageExport:
     # shipped or swapped lane stays charged to its tenant on the pool
     # it lands in; absent in older frames -> the default tenant
     tenant: str = "default"
+    # compression (ISSUE-19): when `quant` is set, pages_k/pages_v are
+    # int8 and scales_k/scales_v carry the per-(layer, page, head)
+    # float32 scales; `quant["exact_dtype"]` remembers what the pages
+    # dequantize back to.  None = exact-bytes frame (v1 layout).
+    quant: Optional[Dict] = None
+    scales_k: Optional[np.ndarray] = None
+    scales_v: Optional[np.ndarray] = None
 
     @property
     def n_pages(self) -> int:
         return int(self.pages_k.shape[1])
 
+    @property
+    def quantized(self) -> bool:
+        return self.quant is not None
+
     def nbytes(self) -> int:
-        return int(self.pages_k.nbytes + self.pages_v.nbytes)
+        """Bytes this export actually carries (the at-rest/wire size):
+        int8 pages + scales when quantized, raw pages when exact."""
+        n = int(self.pages_k.nbytes + self.pages_v.nbytes)
+        if self.scales_k is not None:
+            n += int(self.scales_k.nbytes + self.scales_v.nbytes)
+        return n
+
+    def exact_nbytes(self) -> int:
+        """Bytes the same pages occupy un-quantized (the 4x-denominator
+        the compression ledger reports against)."""
+        if self.quant is None:
+            return int(self.pages_k.nbytes + self.pages_v.nbytes)
+        itemsize = np.dtype(self.quant["exact_dtype"]).itemsize
+        return int(2 * self.pages_k.size * itemsize)
+
+    def dequantized(self) -> "PageExport":
+        """A new exact PageExport with pages restored to
+        `quant["exact_dtype"]` (identity when already exact).  Install
+        paths call this ONCE at the host boundary so the device install
+        program is the same one exact shipments use."""
+        if self.quant is None:
+            return self
+        from deeplearning4j_tpu.precision.quantize import (
+            dequantize_kv_pages,
+        )
+
+        dt = np.dtype(self.quant["exact_dtype"])
+        return dataclasses.replace(
+            self,
+            pages_k=dequantize_kv_pages(self.pages_k, self.scales_k, dt),
+            pages_v=dequantize_kv_pages(self.pages_v, self.scales_v, dt),
+            quant=None, scales_k=None, scales_v=None)
+
+
+def quantize_export(ex: PageExport) -> PageExport:
+    """Exact PageExport -> per-page int8 quantized PageExport (identity
+    when already quantized).  Positions at/past `ex.pos` are zeroed
+    before the scales are computed (stale tail-page garbage must not
+    crush the live rows' precision — `quantize_kv_pages`)."""
+    if ex.quant is not None:
+        return ex
+    from deeplearning4j_tpu.precision.quantize import quantize_kv_pages
+
+    qk, sk = quantize_kv_pages(ex.pages_k, valid=ex.pos)
+    qv, sv = quantize_kv_pages(ex.pages_v, valid=ex.pos)
+    return dataclasses.replace(
+        ex, pages_k=qk, pages_v=qv, scales_k=sk, scales_v=sv,
+        quant={"mode": "int8", "exact_dtype": str(ex.pages_k.dtype)})
 
 
 def serialize_export(ex: PageExport) -> bytes:
     """PageExport -> one wire frame: MAGIC + u32 header length + JSON
-    header + raw page payload (k then v, C-order).  The header's sha256
-    covers the payload bytes exactly as framed."""
+    header + raw page payload (k then v, C-order; a quantized export
+    appends its float32 scale stacks after the int8 pages).  The
+    header's sha256 covers the payload bytes exactly as framed.  Exact
+    exports frame as v1 — byte-identical to the pre-ISSUE-19 format —
+    so quantize-off pools interoperate with old readers unchanged."""
     pk = np.ascontiguousarray(ex.pages_k)
     pv = np.ascontiguousarray(ex.pages_v)
     if pk.shape != pv.shape:
         raise ValueError(f"pages_k {pk.shape} != pages_v {pv.shape}")
     payload = pk.tobytes() + pv.tobytes()
+    if ex.quant is not None:
+        sk = np.ascontiguousarray(ex.scales_k, np.float32)
+        sv = np.ascontiguousarray(ex.scales_v, np.float32)
+        payload += sk.tobytes() + sv.tobytes()
     header = {
-        "version": WIRE_VERSION,
+        "version": WIRE_VERSION if ex.quant is not None else 1,
         "prompt": [int(t) for t in ex.prompt],
         "max_new": int(ex.max_new),
         "temperature": float(ex.temperature),
@@ -134,6 +205,10 @@ def serialize_export(ex: PageExport) -> bytes:
         "sha256": hashlib.sha256(payload).hexdigest(),
         "model": dict(ex.model),
     }
+    if ex.quant is not None:
+        header["quant"] = {"mode": str(ex.quant["mode"]),
+                           "exact_dtype": str(ex.quant["exact_dtype"]),
+                           "scale_shape": list(ex.scales_k.shape)}
     if ex.session_id is not None:
         header["session_id"] = str(ex.session_id)
     if ex.priority != "interactive":
@@ -167,10 +242,10 @@ def deserialize_export(data: bytes) -> PageExport:
     missing = [k for k in _REQUIRED if k not in header]
     if missing:
         raise PageShipError(f"shipment header missing {missing}")
-    if int(header["version"]) != WIRE_VERSION:
+    if int(header["version"]) not in _KNOWN_VERSIONS:
         raise PageShipError(
-            f"shipment wire version {header['version']} != "
-            f"{WIRE_VERSION}")
+            f"shipment wire version {header['version']} not in "
+            f"{_KNOWN_VERSIONS}")
     payload = data[pre + hlen:]
     digest = hashlib.sha256(payload).hexdigest()
     if digest != header["sha256"]:
@@ -183,14 +258,48 @@ def deserialize_export(data: bytes) -> PageExport:
     except TypeError as e:
         raise PageShipError(
             f"shipment dtype {header['dtype']!r} unknown") from e
-    want = 2 * int(np.prod(shape)) * dt.itemsize
+    quant = header.get("quant")
+    sk = sv = None
+    if quant is not None:
+        if quant.get("mode") != "int8":
+            raise PageShipError(
+                f"shipment quantization mode {quant.get('mode')!r} "
+                f"unknown (this reader speaks int8 only)")
+        if dt != np.dtype(np.int8):
+            raise PageShipError(
+                f"quantized shipment payload dtype {dt} != int8")
+        try:
+            np.dtype(quant.get("exact_dtype"))
+        except TypeError as e:
+            raise PageShipError(
+                f"shipment exact_dtype {quant.get('exact_dtype')!r} "
+                f"unknown") from e
+        sshape = tuple(int(d) for d in quant.get("scale_shape", ()))
+        if len(sshape) != 3 or sshape[:2] != (shape[0], shape[1]) or \
+                sshape[2] != shape[3]:
+            raise PageShipError(
+                f"shipment scale stack {sshape} != per-(layer, page, "
+                f"head) for pages {shape}")
+        sbytes = int(np.prod(sshape)) * 4
+    else:
+        sbytes = 0
+    half = int(np.prod(shape)) * dt.itemsize
+    want = 2 * half + 2 * sbytes
     if len(payload) != want:
         raise PageShipError(
             f"shipment payload {len(payload)} bytes != {want} for "
-            f"2 x {shape} {dt}")
-    half = want // 2
+            f"2 x {shape} {dt}"
+            + (f" + 2 x {sshape} float32 scales" if quant else ""))
     pk = np.frombuffer(payload[:half], dt).reshape(shape)
-    pv = np.frombuffer(payload[half:], dt).reshape(shape)
+    pv = np.frombuffer(payload[half:2 * half], dt).reshape(shape)
+    if quant is not None:
+        sk = np.frombuffer(
+            payload[2 * half:2 * half + sbytes], np.float32
+        ).reshape(sshape)
+        sv = np.frombuffer(payload[2 * half + sbytes:], np.float32
+                           ).reshape(sshape)
+        quant = {"mode": "int8",
+                 "exact_dtype": str(quant["exact_dtype"])}
     return PageExport(
         prompt=[int(t) for t in header["prompt"]],
         max_new=int(header["max_new"]),
@@ -202,11 +311,13 @@ def deserialize_export(data: bytes) -> PageExport:
         pages_k=pk, pages_v=pv, model=dict(header["model"]),
         session_id=header.get("session_id"),
         priority=str(header.get("priority", "interactive")),
-        tenant=str(header.get("tenant", "default")))
+        tenant=str(header.get("tenant", "default")),
+        quant=quant, scales_k=sk, scales_v=sv)
 
 
 def check_compatible(ex: PageExport, cfg, page_size: int,
-                     mid_decode: bool = False) -> None:
+                     mid_decode: bool = False,
+                     prefix: bool = False) -> None:
     """The import gate: shipped geometry must equal the importing
     pool's, field for field — a page stack cut for different
     layers/heads/dtype/page-size would install as silent garbage.
@@ -215,7 +326,12 @@ def check_compatible(ex: PageExport, cfg, page_size: int,
     ``mid_decode`` relaxes the prefill-boundary invariant for the
     overload-survival plane (ISSUE-15): a PREEMPTED lane swaps out
     mid-decode, so its ``pos`` sits anywhere past the prompt — but the
-    page-count and committed-token invariants still hold exactly."""
+    page-count and committed-token invariants still hold exactly.
+
+    ``prefix`` gates HIBERNATION frames (ISSUE-19): not a live lane but
+    a whole-page prompt prefix — ``prompt`` is exactly the covered
+    tokens, ``pos`` sits on a page boundary, and ``committed`` is empty
+    (nothing was mid-flight; the resuming lane re-runs its own tail)."""
     local = model_signature(cfg, page_size)
     bad = [f"{k}: shipped {ex.model.get(k)!r} != local {v!r}"
            for k, v in local.items() if ex.model.get(k) != v]
@@ -228,7 +344,21 @@ def check_compatible(ex: PageExport, cfg, page_size: int,
         raise PageShipError(
             f"shipment page stack {tuple(ex.pages_k.shape)} != "
             f"{want} for this pool's geometry")
-    if mid_decode:
+    if prefix:
+        if ex.pos != len(ex.prompt):
+            raise PageShipError(
+                f"hibernated prefix pos {ex.pos} != covered tokens "
+                f"{len(ex.prompt)}: a prefix frame stores exactly what "
+                f"its pages hold")
+        if ex.pos % local["page_size"] != 0:
+            raise PageShipError(
+                f"hibernated prefix pos {ex.pos} is not a multiple of "
+                f"page_size {local['page_size']}: only FULL pages rest")
+        if ex.committed:
+            raise PageShipError(
+                f"hibernated prefix carries {len(ex.committed)} "
+                f"committed tokens: prefix frames hold pages, not lanes")
+    elif mid_decode:
         if ex.pos < len(ex.prompt):
             raise PageShipError(
                 f"swapped lane pos {ex.pos} < prompt length "
@@ -237,7 +367,7 @@ def check_compatible(ex: PageExport, cfg, page_size: int,
         raise PageShipError(
             f"shipment pos {ex.pos} != prompt length "
             f"{len(ex.prompt)}: only prefill-complete lanes ship")
-    if not ex.committed:
+    if not prefix and not ex.committed:
         raise PageShipError(
             "shipment carries no committed token: prefill completion "
             "always samples the first one")
@@ -255,5 +385,6 @@ __all__ = [
     "check_compatible",
     "deserialize_export",
     "model_signature",
+    "quantize_export",
     "serialize_export",
 ]

@@ -819,7 +819,11 @@ class UiServer:
                  prefill_chunk: int = 8, speculate: str = "off",
                  draft_len: int = 4, ship: bool = False,
                  preempt: bool = False, swap_bytes: int = 64 << 20,
-                 brownout=None, tenants=None) -> "UiServer":
+                 brownout=None, tenants=None,
+                 hibernate_idle_s: Optional[float] = None,
+                 state_dir: Optional[str] = None,
+                 state_disk_bytes: int = 1 << 30,
+                 swap_quantize: bool = True) -> "UiServer":
         """Register a TransformerLM for POST /lm/generate.  With
         `continuous` (default) greedy/temperature requests decode in a
         `slots`-lane continuous batching pool; `continuous=False` keeps
@@ -843,7 +847,14 @@ class UiServer:
         `-tenants` JSON text) installs the multi-tenant traffic-shaping
         plane: per-tenant WFQ ordering, token-bucket quotas (429 +
         Retry-After), and SLO burn-rate accounting (docs/robustness.md
-        "Tenancy & SLOs")."""
+        "Tenancy & SLOs").  `hibernate_idle_s`/`state_dir`/
+        `state_disk_bytes` configure the tiered KV state hierarchy
+        (ISSUE-19): idle sticky sessions hibernate to the host tier and
+        spill to an integrity-checked disk tier, resuming
+        byte-identically — even after a process restart over the same
+        `state_dir`; `swap_quantize=False` keeps swap/hibernate frames
+        exact instead of per-page int8 (docs/robustness.md "The state
+        hierarchy")."""
         lm_server = None
         if continuous:
             from deeplearning4j_tpu.serving import (
@@ -863,6 +874,9 @@ class UiServer:
                 draft_len=draft_len, ship=ship, preempt=preempt,
                 swap_bytes=swap_bytes, brownout=brownout,
                 tenants=tenants,
+                hibernate_idle_s=hibernate_idle_s, state_dir=state_dir,
+                state_disk_bytes=state_disk_bytes,
+                swap_quantize=swap_quantize,
                 tracer=self.state.tracer,
                 registry=self.state.registry)
         with self.state.lock:

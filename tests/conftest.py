@@ -103,6 +103,15 @@ def pytest_configure(config):
         "corruption fallback, crash-safe resume incl. a real training "
         "process killed mid-save (fast; run in tier-1)")
     config.addinivalue_line(
+        "markers", "hibernate: tiered KV state hierarchy — host/disk "
+        "TieredStateStore economy, int8 quantized frames at rest, "
+        "idle-session hibernate → resume byte-parity (greedy/seeded, "
+        "composed with speculation/radix/chunked prefill), full "
+        "process-restart resume over the same disk dir, disk chaos "
+        "ladder (torn/truncated/corrupt/missing/ENOSPC/kill -9) with "
+        "typed per-victim errors and recompute fallback (fast; run in "
+        "tier-1)")
+    config.addinivalue_line(
         "markers", "paged_kernel: Pallas paged-attention decode kernel "
         "— fused block-table walk vs. the gather oracle (ragged "
         "n_feed, page straddles, C>1 chunk/verify widths, null lanes, "

@@ -92,12 +92,21 @@ PRESSURE_PREFIX = "deeplearning4j_tpu/serving/pressure.py"
 TENANCY_ALLOWLIST: dict = {}
 TENANCY_PREFIX = "deeplearning4j_tpu/serving/tenancy.py"
 
+# The tiered KV state hierarchy (ISSUE-19) persists session KV across
+# processes: a swallowed integrity/manifest error here resumes silent
+# garbage KV hours later — durability failures must stay OSError-narrow
+# and surface as the typed SwapEvictedError/PageShipError ladder.  No
+# broad handlers at all, pragma'd or not.
+HIBERNATE_ALLOWLIST: dict = {}
+HIBERNATE_PREFIX = "deeplearning4j_tpu/serving/hibernate.py"
+
 # prefix -> (allowlist, label) for the strict-mode passes (first match
 # wins, so file-level prefixes go before their parent directory)
 STRICT_PREFIXES = (
     (TRANSFER_PREFIX, TRANSFER_ALLOWLIST, "TRANSFER_ALLOWLIST"),
     (PRESSURE_PREFIX, PRESSURE_ALLOWLIST, "PRESSURE_ALLOWLIST"),
     (TENANCY_PREFIX, TENANCY_ALLOWLIST, "TENANCY_ALLOWLIST"),
+    (HIBERNATE_PREFIX, HIBERNATE_ALLOWLIST, "HIBERNATE_ALLOWLIST"),
     (SERVING_PREFIX, SERVING_ALLOWLIST, "SERVING_ALLOWLIST"),
     (OBS_PREFIX, OBS_ALLOWLIST, "OBS_ALLOWLIST"),
     (LAUNCHER_PREFIX, LAUNCHER_ALLOWLIST, "LAUNCHER_ALLOWLIST"),
